@@ -1,0 +1,24 @@
+"""rocket_tpu — a TPU-native, event-driven training-pipeline framework.
+
+Capability-equivalent to dsenushkin/rocket (see SURVEY.md): a composable tree
+of lifecycle-driven capsules over an Attributes blackboard — but with the
+execution engine built on JAX/XLA: jitted train steps under a
+jax.sharding.Mesh, XLA collectives over ICI, bf16 policy, Orbax persistence.
+
+The public surface is flattened here the same way the reference flattens
+``rocket.core`` into ``rocket.*`` (``rocket/__init__.py:1``).
+"""
+
+from rocket_tpu.core import Attributes, Capsule, Dispatcher, Events
+from rocket_tpu.runtime import Runtime
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Attributes",
+    "Capsule",
+    "Dispatcher",
+    "Events",
+    "Runtime",
+    "__version__",
+]

@@ -1,0 +1,8 @@
+"""Core capsule protocol (reference ``rocket/core/__init__.py:1-12``)."""
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.core.dispatcher import Dispatcher
+from rocket_tpu.core.events import Events
+
+__all__ = ["Attributes", "Capsule", "Dispatcher", "Events"]

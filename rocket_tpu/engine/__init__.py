@@ -1,0 +1,3 @@
+from rocket_tpu.engine.precision import Policy
+
+__all__ = ["Policy"]

@@ -1,0 +1,3 @@
+from rocket_tpu.observe.logging import RankAwareLogger, get_logger
+
+__all__ = ["RankAwareLogger", "get_logger"]

@@ -1,0 +1,141 @@
+"""Host-level coordination (DCN) — process launch, rendezvous, object sync.
+
+Replaces the reference's accelerate/c10d host-side surface: process-group
+init (implicit in ``Accelerator()``, ``launcher.py:185``),
+``broadcast_object_list`` (``launcher.py:150,161``), the mkdir barrier
+(``launcher.py:156-161``), and ``PartialState().destroy_process_group()``
+(``launcher.py:289-291``).
+
+On TPU pods there is one process per host; ICI collectives are compiled by
+XLA, while everything here rides DCN via ``jax.distributed``.  Every function
+degrades to a no-op/identity in single-process runs so the same pipeline code
+is CPU-runnable.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+_initialized = False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the multi-host runtime (idempotent; no-op for single-process
+    runs).  Must be called before the first JAX computation — it therefore
+    performs NO jax calls itself before ``jax.distributed.initialize``.
+
+    Reference analogue: process-group init inside ``Accelerator()``
+    (``launcher.py:185-193``) / ``notebook_launcher`` (``launcher.py:239``).
+    """
+    global _initialized
+    if _initialized:
+        return
+    import os
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None:
+        return  # single-process run (or TPU runtime pre-wired via env)
+    kwargs = dict(coordinator_address=coordinator_address)
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as err:
+        text = str(err)
+        if "already initialized" in text:
+            pass  # someone (launcher/runtime) beat us to it — fine
+        else:
+            raise
+    _initialized = True
+
+
+def shutdown() -> None:
+    """Tear down the multi-host runtime (reference ``launcher.py:289-291``)."""
+    global _initialized
+    if _initialized:
+        jax.distributed.shutdown()
+        _initialized = False
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0
+
+
+def sync_global_devices(name: str) -> None:
+    """Barrier across all hosts (reference mkdir barrier,
+    ``launcher.py:159-161``)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_one_to_all(value: Any, is_source: Optional[bool] = None) -> Any:
+    """Broadcast a pytree of arrays from host 0 to all hosts
+    (reference ``broadcast_object_list``, ``launcher.py:150``)."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value, is_source=is_source)
+
+
+def broadcast_object(obj: Any, is_source: Optional[bool] = None) -> Any:
+    """Broadcast an arbitrary picklable python object from host 0 — the
+    project-dir sync path (``launcher.py:125-150``).  Encoded as a padded
+    uint8 buffer over :func:`broadcast_one_to_all`."""
+    if jax.process_count() == 1:
+        return obj
+    if is_source is None:
+        is_source = is_main_process()
+    payload = pickle.dumps(obj) if is_source else b""
+    # Fixed-size header exchange: first broadcast length, then the buffer.
+    length = np.asarray(len(payload), dtype=np.int64)
+    length = int(broadcast_one_to_all(length, is_source=is_source))
+    buf = np.zeros(length, dtype=np.uint8)
+    if is_source:
+        buf[:] = np.frombuffer(payload, dtype=np.uint8)
+    buf = broadcast_one_to_all(buf, is_source=is_source)
+    return pickle.loads(buf.tobytes())
+
+
+def process_allgather(value: Any, tiled: bool = True) -> Any:
+    """Gather a per-host pytree onto every host (reference
+    ``gather_for_metrics`` transport, ``meter.py:93``; padding dedup is done
+    by the caller via valid-masks — see rocket_tpu.observe.meter)."""
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(np.asarray, value)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(value, tiled=tiled)
+
+
+def assert_equal(value: Any, fail_message: str = "") -> None:
+    """Debug-mode cross-host agreement check (SURVEY §5.2): asserts all hosts
+    hold identical values (step counters, dir names, termination votes)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.assert_equal(value, fail_message)

@@ -1,0 +1,32 @@
+"""Test configuration: force an 8-fake-device CPU backend.
+
+SURVEY §4: multi-device behavior is tested without a cluster via
+``--xla_force_host_platform_device_count=8`` — the TPU-world equivalent of a
+fake backend.  Must run before the first ``import jax`` in any test module.
+"""
+
+import os
+
+# Neutralize the axon TPU tunnel for tests: sitecustomize imports jax at
+# interpreter start, so plain env vars are too late — but backend selection
+# is lazy until the first jax.devices(), so switching the platform via
+# jax.config still works here.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake cpu devices, got {devs}"
+    return devs

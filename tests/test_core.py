@@ -1,0 +1,130 @@
+"""Unit tests for the capsule protocol (blackboard, events, priority, LIFO)."""
+
+import numpy as np
+import pytest
+
+from rocket_tpu.core import Attributes, Capsule, Dispatcher, Events
+from rocket_tpu.parallel import MeshSpec
+from rocket_tpu.runtime import Runtime
+
+
+class Recorder(Capsule):
+    def __init__(self, log, name, **kwargs):
+        super().__init__(**kwargs)
+        self._log = log
+        self._name = name
+
+    def setup(self, attrs=None):
+        super().setup(attrs)
+        self._log.append(("setup", self._name))
+
+    def launch(self, attrs=None):
+        self._log.append(("launch", self._name))
+
+    def destroy(self, attrs=None):
+        self._log.append(("destroy", self._name))
+        super().destroy(attrs)
+
+
+class TestAttributes:
+    def test_missing_key_reads_none(self):
+        attrs = Attributes()
+        assert attrs.anything is None
+
+    def test_dot_write_read_delete(self):
+        attrs = Attributes()
+        attrs.batch = 42
+        assert attrs["batch"] == 42 and attrs.batch == 42
+        del attrs.batch
+        assert attrs.batch is None
+
+    def test_nested_dict_promotion(self):
+        attrs = Attributes(looper={"state": {"loss": 1.0}})
+        assert isinstance(attrs.looper.state, Attributes)
+        assert attrs.looper.state.loss == 1.0
+        attrs.tracker = {"scalars": []}
+        assert attrs.tracker.scalars == []
+
+    def test_is_pytree(self):
+        import jax
+
+        attrs = Attributes(a=np.ones(3), b={"c": np.zeros(2)})
+        doubled = jax.tree_util.tree_map(lambda x: x * 2, attrs)
+        assert isinstance(doubled, Attributes)
+        assert float(doubled.a[0]) == 2.0
+        assert isinstance(doubled.b, Attributes)
+
+
+class TestDispatchOrdering:
+    def test_priority_descending_and_destroy_reversed(self):
+        log = []
+        rt = Runtime()
+        caps = [
+            Recorder(log, "low", priority=100),
+            Recorder(log, "high", priority=1100),
+            Recorder(log, "mid", priority=1000),
+        ]
+        tree = Dispatcher(caps)
+        tree.bind(rt)
+        tree.setup()
+        tree.launch()
+        tree.destroy()
+        assert [n for e, n in log if e == "setup"] == ["high", "mid", "low"]
+        assert [n for e, n in log if e == "launch"] == ["high", "mid", "low"]
+        assert [n for e, n in log if e == "destroy"] == ["low", "mid", "high"]
+
+    def test_dispatch_event_routing(self):
+        log = []
+        cap = Recorder(log, "x")
+        cap.bind(Runtime())
+        cap.dispatch(Events.SETUP)
+        cap.dispatch(Events.LAUNCH)
+        assert log == [("setup", "x"), ("launch", "x")]
+
+    def test_non_capsule_child_rejected(self):
+        with pytest.raises(TypeError):
+            Dispatcher([object()])
+
+
+class TestStatefulRegistry:
+    def test_lifo_registration(self):
+        rt = Runtime()
+        a = Capsule(statefull=True, priority=1100)
+        b = Capsule(statefull=True, priority=1000)
+        tree = Dispatcher([a, b])
+        tree.bind(rt)
+        tree.setup()
+        assert rt.checkpointables == [a, b]
+        tree.destroy()
+        assert rt.checkpointables == []
+
+    def test_out_of_order_destroy_raises(self):
+        rt = Runtime()
+        a = Capsule(statefull=True)
+        b = Capsule(statefull=True)
+        a.bind(rt)
+        b.bind(rt)
+        a.setup()
+        b.setup()
+        with pytest.raises(RuntimeError, match="LIFO"):
+            a.destroy()
+
+    def test_unbound_capsule_raises(self):
+        with pytest.raises(RuntimeError, match="no runtime"):
+            Capsule(statefull=True).setup()
+
+
+class TestRuntime:
+    def test_mesh_axes_and_dp_size(self, devices):
+        rt = Runtime(mesh=MeshSpec(data=2, fsdp=2, tensor=2))
+        assert rt.mesh.shape["data"] == 2
+        assert rt.data_parallel_size == 4
+        assert rt.device_count == 8
+
+    def test_dedupe_registry(self):
+        rt = Runtime()
+        obj = object()
+        assert rt.register_unique("module", obj)
+        assert not rt.register_unique("module", obj)
+        rt.deregister_unique("module", obj)
+        assert rt.register_unique("module", obj)
