@@ -9,16 +9,42 @@ The public surface is flattened here the same way the reference flattens
 ``rocket.core`` into ``rocket.*`` (``rocket/__init__.py:1``).
 """
 
-from rocket_tpu.core import Attributes, Capsule, Dispatcher, Events
+from rocket_tpu.core import (
+    Attributes,
+    Capsule,
+    Dispatcher,
+    Events,
+    Loss,
+    Module,
+    Optimizer,
+    Scheduler,
+)
+from rocket_tpu.data import ArraySource, DataLoader, Dataset
+from rocket_tpu.launch import Launcher, Looper
+from rocket_tpu.observe import Meter, Metric, Tracker
+from rocket_tpu.persist import Checkpointer
 from rocket_tpu.runtime import Runtime
 
 __version__ = "0.1.0"
 
 __all__ = [
+    "ArraySource",
     "Attributes",
     "Capsule",
+    "Checkpointer",
+    "DataLoader",
+    "Dataset",
     "Dispatcher",
     "Events",
+    "Launcher",
+    "Looper",
+    "Loss",
+    "Meter",
+    "Metric",
+    "Module",
+    "Optimizer",
     "Runtime",
+    "Scheduler",
+    "Tracker",
     "__version__",
 ]

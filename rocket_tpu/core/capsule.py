@@ -30,7 +30,7 @@ from typing import Any, Optional
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.events import Events
-from rocket_tpu.observe.logging import RankAwareLogger, get_logger
+from rocket_tpu.utils.logging import RankAwareLogger, get_logger
 
 
 class Capsule:
@@ -60,6 +60,7 @@ class Capsule:
         self._priority = priority
         self._logger = logger or get_logger(type(self).__name__)
         self._registered = False
+        self._ckpt_key: Optional[str] = None
 
     # -- lifecycle ----------------------------------------------------------
 
@@ -67,24 +68,22 @@ class Capsule:
         """One-time initialization. Registers stateful capsules for
         checkpointing (reference ``capsule.py:116-141``)."""
         self.check_runtime()
-        if self._statefull:
-            self._runtime.register_for_checkpointing(self)
+        if self._statefull and not self._registered:
+            # Idempotent: the same capsule mounted in two pipeline branches
+            # (train + eval looper) is set up twice but registers once —
+            # the analogue of the reference's dedupe scans
+            # (``module.py:87-99``, ``dataset.py:158-171``).
+            self._ckpt_key = self._runtime.register_for_checkpointing(self)
             self._registered = True
         self._logger.debug("%s.setup done", type(self).__name__)
 
     def destroy(self, attrs: Optional[Attributes] = None) -> None:
-        """One-time teardown. Deregisters from the checkpoint registry in
-        LIFO order (reference ``capsule.py:143-176``)."""
+        """One-time teardown. Deregisters from the checkpoint registry
+        (reference pops LIFO, ``capsule.py:165-174``; here removal is by
+        identity — see ``Runtime.deregister_checkpointable``)."""
         if self._statefull and self._registered:
             self.check_runtime()
-            popped = self._runtime.pop_checkpointable()
-            if popped is not self:
-                raise RuntimeError(
-                    f"{type(self).__name__}.destroy: checkpoint registry is "
-                    f"not LIFO-consistent — expected self, got "
-                    f"{type(popped).__name__}. Destroy capsules in reverse "
-                    f"setup order."
-                )
+            self._runtime.deregister_checkpointable(self)
             self._registered = False
         self.clear()
 

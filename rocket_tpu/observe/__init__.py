@@ -1,3 +1,21 @@
-from rocket_tpu.observe.logging import RankAwareLogger, get_logger
+from rocket_tpu.observe.backends import (
+    JsonlBackend,
+    MemoryBackend,
+    TensorBoardBackend,
+    TrackerBackend,
+)
+from rocket_tpu.utils.logging import RankAwareLogger, get_logger
+from rocket_tpu.observe.meter import Meter, Metric
+from rocket_tpu.observe.tracker import Tracker
 
-__all__ = ["RankAwareLogger", "get_logger"]
+__all__ = [
+    "JsonlBackend",
+    "MemoryBackend",
+    "Meter",
+    "Metric",
+    "RankAwareLogger",
+    "TensorBoardBackend",
+    "Tracker",
+    "TrackerBackend",
+    "get_logger",
+]

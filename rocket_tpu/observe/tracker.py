@@ -1,0 +1,104 @@
+"""Tracker — buffered experiment logging.
+
+Capability parity: reference ``rocket/core/tracker.py:22-254``:
+
+- priority **200** so it runs after compute/metric capsules in each
+  iteration (SURVEY §2.3);
+- the buffered protocol: ``set`` publishes
+  ``attrs.tracker = {scalars: [], images: []}`` (``tracker.py:124``),
+  producers append ``{step, data}`` records (``loss.py:103-109``,
+  ``optimizer.py:134-142``), ``launch``/``reset`` flush (``:126-180``);
+- main-process-only writes (``:234-254``);
+- backend get-or-create through the runtime registry (``:86-105``).
+
+TPU-first: records hold **device scalars** (lazy jax arrays); conversion to
+floats happens only at flush, every ``flush_every`` iterations — so logging
+adds zero host-device synchronization to the steady-state loop (the
+reference synced every iteration; SURVEY §2.4 flags the cost).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.observe.backends import TrackerBackend, resolve_backend
+
+
+class Tracker(Capsule):
+    def __init__(
+        self,
+        backend: Any = "tensorboard",
+        flush_every: int = 10,
+        statefull: bool = False,
+        priority: int = 200,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, logger=logger)
+        self._backend_spec = backend
+        self._backend: Optional[TrackerBackend] = None
+        self._flush_every = max(1, int(flush_every))
+        self._since_flush = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def setup(self, attrs: Optional[Attributes] = None) -> None:
+        super().setup(attrs)
+        name = (
+            self._backend_spec
+            if isinstance(self._backend_spec, str)
+            else type(self._backend_spec).__name__
+        )
+        existing = self._runtime.get_tracker(name)
+        if existing is not None:
+            self._backend = existing  # shared across pipeline branches
+            return
+        self._backend = resolve_backend(
+            self._backend_spec, self._runtime.logging_dir
+        )
+        self._runtime.register_tracker(name, self._backend)
+
+    def destroy(self, attrs: Optional[Attributes] = None) -> None:
+        self._backend = None  # closed by runtime.end_training()
+        super().destroy(attrs)
+
+    # -- cycle ---------------------------------------------------------------
+
+    def set(self, attrs: Optional[Attributes] = None) -> None:
+        """Open the per-cycle buffers (reference ``tracker.py:107-124``)."""
+        if attrs is None:
+            return
+        attrs.tracker = Attributes(scalars=[], images=[])
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        if attrs is None or attrs.tracker is None:
+            return
+        self._since_flush += 1
+        if self._since_flush >= self._flush_every:
+            self.log(attrs)
+
+    def reset(self, attrs: Optional[Attributes] = None) -> None:
+        """Final flush + drop the buffers (reference ``tracker.py:154-180``)."""
+        if attrs is None or attrs.tracker is None:
+            return
+        self.log(attrs)
+        del attrs.tracker
+
+    # -- flush ---------------------------------------------------------------
+
+    def log(self, attrs: Attributes) -> None:
+        """Drain buffers to the backend; writes on the main process only
+        (reference ``tracker.py:201-254``)."""
+        self._since_flush = 0
+        tracker = attrs.tracker
+        if tracker is None or self._backend is None:
+            return
+        scalars, tracker.scalars = tracker.scalars, []
+        images, tracker.images = tracker.images, []
+        if self._runtime is not None and not self._runtime.is_main_process:
+            return
+        for record in scalars:
+            self._backend.log_scalars(dict(record.data), int(record.step))
+        for record in images:
+            self._backend.log_images(dict(record.data), int(record.step))

@@ -139,3 +139,51 @@ def assert_equal(value: Any, fail_message: str = "") -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.assert_equal(value, fail_message)
+
+
+def _local_rows(value: Any) -> np.ndarray:
+    """This host's unique dim-0 rows of a sharded array, in index order.
+    Shards replicated over non-data mesh axes carry identical dim-0 slices —
+    keep one per distinct slice (dedup), or metrics would count every sample
+    once per model/tensor-parallel replica."""
+    seen = set()
+    picked = []
+    for shard in value.addressable_shards:
+        start = (shard.index[0].start or 0) if shard.index else 0
+        if start in seen:
+            continue
+        seen.add(start)
+        picked.append((start, shard))
+    picked.sort(key=lambda pair: pair[0])
+    return np.concatenate([np.asarray(s.data) for _, s in picked], axis=0)
+
+
+def to_host_global(value: Any) -> Any:
+    """Materialize a pytree of (possibly mesh-sharded) arrays as full
+    host-side numpy arrays on every process — the transport half of the
+    reference's ``gather_for_metrics`` (``meter.py:93``); padding dedup is
+    the caller's valid-mask job (SURVEY §7.4).
+
+    Fully-addressable arrays (single host, or replicated outputs) are just
+    device_get; cross-host sharded leaves are gathered over DCN in ONE
+    collective for the whole tree.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(value)
+    out = [None] * len(leaves)
+    pending = {}  # leaf position -> host-local rows
+    for i, leaf in enumerate(leaves):
+        if not hasattr(leaf, "addressable_shards") or getattr(
+            leaf, "is_fully_addressable", True
+        ):
+            out[i] = np.asarray(leaf)
+        else:
+            pending[i] = _local_rows(leaf)
+    if pending:
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(
+            list(pending.values()), tiled=True
+        )
+        for pos, host_global in zip(pending.keys(), gathered):
+            out[pos] = np.asarray(host_global)
+    return jax.tree_util.tree_unflatten(treedef, out)

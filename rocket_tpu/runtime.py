@@ -69,10 +69,16 @@ class Runtime:
         self.seed = int(seed)
 
         self._checkpointables: List[Any] = []
+        self._ckpt_counter = 0
         self._unique: Dict[str, List[Any]] = {}
         self._trackers: Dict[str, Any] = {}
         self.project_dir: Optional[str] = None
         self.logging_dir: Optional[str] = None
+        # Pending resume request (set by Launcher.resume): Attributes with
+        # ``path`` and ``load_capsules``.  Capsules with lazily-materialized
+        # array state (Module) consume it at materialization time; host-scalar
+        # states are restored by Launcher._resume right after setup.
+        self.resume_spec: Optional[Any] = None
 
     # -- topology -----------------------------------------------------------
 
@@ -118,18 +124,40 @@ class Runtime:
 
     # -- checkpoint registry (LIFO, reference capsule.py:135-174) ------------
 
-    def register_for_checkpointing(self, capsule: Any) -> None:
+    def register_for_checkpointing(self, capsule: Any) -> str:
+        """Register a stateful capsule; returns its stable checkpoint key
+        (``<classname>_<index>`` — deterministic because setup order is the
+        priority-sorted tree order)."""
         if capsule in self._checkpointables:
             raise RuntimeError(
                 f"{type(capsule).__name__} is already registered for "
                 f"checkpointing — mount each stateful capsule once."
             )
+        # Monotonic counter — indexes are never reused even after a
+        # deregister, so two live capsules can never collide on a key.
+        key = f"{type(capsule).__name__.lower()}_{self._ckpt_counter}"
+        self._ckpt_counter += 1
         self._checkpointables.append(capsule)
+        return key
 
-    def pop_checkpointable(self) -> Any:
-        if not self._checkpointables:
-            raise RuntimeError("checkpoint registry is empty")
-        return self._checkpointables.pop()
+    def deregister_checkpointable(self, capsule: Any) -> None:
+        """Remove a capsule from the registry by identity.
+
+        The reference deregisters by LIFO pop against accelerate's
+        ``_custom_objects`` because its checkpoint format matches states by
+        LIST POSITION (``capsule.py:165-174``).  Ours matches by stable
+        string key, so destroy order cannot corrupt a checkpoint — and
+        capsules legitimately shared across pipeline branches (one Module in
+        the train and eval looper) make strict LIFO impossible.
+        """
+        for i, existing in enumerate(self._checkpointables):
+            if existing is capsule:
+                del self._checkpointables[i]
+                return
+        raise RuntimeError(
+            f"{type(capsule).__name__} is not in the checkpoint registry — "
+            f"double destroy?"
+        )
 
     @property
     def checkpointables(self) -> List[Any]:

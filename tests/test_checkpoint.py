@@ -1,0 +1,160 @@
+"""Checkpoint / resume tests (SURVEY §3.4 semantics + §4 round-trip
+requirement)."""
+
+import os
+
+import numpy as np
+import pytest
+
+import rocket_tpu as rt
+from rocket_tpu.models.objectives import cross_entropy
+from rocket_tpu.observe.backends import MemoryBackend
+
+from test_pipeline import MLP, synthetic_classification
+
+
+def _tree(tmp_path, data, *, epochs, save_every=4, resume=None, load_capsules=True,
+          project_root=None, seed=0):
+    model = rt.Module(
+        MLP(),
+        capsules=[
+            rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+            rt.Optimizer(learning_rate=2e-2),
+        ],
+    )
+    looper = rt.Looper(
+        capsules=[
+            rt.Dataset(rt.ArraySource(data), batch_size=64, shuffle=True, seed=7),
+            model,
+            rt.Checkpointer(save_every=save_every),
+        ],
+        progress=False,
+    )
+    launcher = rt.Launcher(
+        capsules=[looper],
+        tag="ckpt",
+        num_epochs=epochs,
+        project_root=str(project_root or tmp_path),
+        seed=seed,
+    )
+    if resume:
+        launcher.resume(resume, load_capsules=load_capsules)
+    return launcher, model
+
+
+def test_checkpoint_write_and_full_resume(tmp_path, devices):
+    data = synthetic_classification(n=256)
+    launcher, model = _tree(tmp_path, data, epochs=2)
+    launcher.launch()
+    # 256/64 = 4 iters/epoch, 2 epochs -> saves at iter 0 and 4
+    v0 = tmp_path / "ckpt" / "v0"
+    ckpts = sorted((v0 / "weights").iterdir())
+    assert [c.name for c in ckpts] == ["000000", "000004"]
+    trained_step = model.step
+    assert trained_step == 8
+
+    # Full resume from the last snapshot: step counter restores to 4 (saved
+    # post-step at iteration boundary), then continues to 8 + 4 more.
+    launcher2, model2 = _tree(
+        tmp_path, data, epochs=3, resume=str(ckpts[-1]), load_capsules=True
+    )
+    launcher2.launch()
+    # resumed at epoch 1 (saved during epoch 1... epoch_idx stored = 1),
+    # runs epochs 1 and 2 from the restored state
+    assert model2.step > 4
+
+
+def test_full_resume_restores_exact_state(tmp_path, devices):
+    """Save -> restore -> params bitwise equal (SURVEY §4: checkpoint
+    round-trip)."""
+    data = synthetic_classification(n=256)
+    launcher, model = _tree(tmp_path, data, epochs=1, save_every=100)
+    launcher.launch()
+    # manual snapshot of the trained state via the public Checkpointer path
+    from rocket_tpu.persist import default_io
+
+    state = model.state
+    path = str(tmp_path / "manual")
+    default_io().save(path, {"module_x": {"state": state}}, wait=True)
+
+    import jax
+
+    restored = default_io().restore_item(
+        path,
+        "module_x",
+        target={
+            "state": jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding),
+                state,
+            )
+        },
+    )["state"]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params),
+        jax.tree_util.tree_leaves(restored.params),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(restored.step) == int(state.step)
+
+
+def test_weights_only_resume(tmp_path, devices):
+    data = synthetic_classification(n=256)
+    launcher, model = _tree(tmp_path, data, epochs=2)
+    launcher.launch()
+    ckpt = str(tmp_path / "ckpt" / "v0" / "weights" / "000004")
+    trained_params = model.state.params
+
+    launcher2, model2 = _tree(
+        tmp_path, data, epochs=1, resume=ckpt, load_capsules=False
+    )
+    # trigger materialization via one launch
+    launcher2.launch()
+    # optimizer state started fresh: step counts only this run's iterations
+    assert model2.step == 4  # 1 epoch x 4 iters, NOT resumed 4 + 4
+    # but weights started from the checkpoint, not from init: the loss of the
+    # first step should already be low
+    import jax
+
+    leaves_restored = jax.tree_util.tree_leaves(trained_params)
+    assert leaves_restored  # sanity
+
+
+def test_mid_epoch_data_resume_determinism(devices):
+    """skip_batches replays the permutation: batches [k:] of a resumed epoch
+    equal batches [k:] of an uninterrupted one (reference
+    skip_first_batches, dataset.py:205-210)."""
+    from rocket_tpu.data import ArraySource, DataLoader
+
+    data = synthetic_classification(n=128)
+    loader = DataLoader(ArraySource(data), batch_size=32, shuffle=True, seed=5)
+    full = [b for b in loader.iterate(epoch=3)]
+    resumed = [b for b in loader.iterate(epoch=3, skip_batches=2)]
+    assert len(resumed) == len(full) - 2
+    for a, b in zip(full[2:], resumed):
+        np.testing.assert_array_equal(np.asarray(a["x"]), np.asarray(b["x"]))
+
+
+def test_topology_guard(tmp_path, devices):
+    """Resume refuses a different process count (reference
+    launcher.py:370-375). Single-process env: simulate by editing the saved
+    launcher state."""
+    data = synthetic_classification(n=128)
+    launcher, _ = _tree(tmp_path, data, epochs=1)
+    launcher.launch()
+    ckpt = tmp_path / "ckpt" / "v0" / "weights" / "000000"
+
+    launcher2, _ = _tree(tmp_path, data, epochs=1, resume=str(ckpt))
+    launcher2._saved_num_procs = None  # reset
+    # monkey-wrench: pretend the checkpoint was written by 4 processes
+    orig = rt.Launcher.load_state_dict
+
+    def fake_load(self, state):
+        orig(self, state)
+        self._saved_num_procs = 4
+
+    rt.Launcher.load_state_dict = fake_load
+    try:
+        with pytest.raises(RuntimeError, match="topology"):
+            launcher2.launch()
+    finally:
+        rt.Launcher.load_state_dict = orig

@@ -98,7 +98,9 @@ class TestStatefulRegistry:
         tree.destroy()
         assert rt.checkpointables == []
 
-    def test_out_of_order_destroy_raises(self):
+    def test_out_of_order_destroy_allowed(self):
+        # Identity-keyed deregistration: destroy order is free (the
+        # reference needed LIFO because accelerate matched by position).
         rt = Runtime()
         a = Capsule(statefull=True)
         b = Capsule(statefull=True)
@@ -106,8 +108,10 @@ class TestStatefulRegistry:
         b.bind(rt)
         a.setup()
         b.setup()
-        with pytest.raises(RuntimeError, match="LIFO"):
-            a.destroy()
+        a.destroy()
+        assert rt.checkpointables == [b]
+        with pytest.raises(RuntimeError, match="double destroy"):
+            a.bind(rt) or setattr(a, "_registered", True) or a.destroy()
 
     def test_unbound_capsule_raises(self):
         with pytest.raises(RuntimeError, match="no runtime"):
