@@ -181,6 +181,9 @@ class Module(Dispatcher):
         mesh = runtime.mesh
         policy = runtime.policy
         rng = jax.random.PRNGKey(runtime.seed)
+        configure = getattr(self._adapter, "configure", None)
+        if configure is not None:
+            configure(mesh, runtime.rules)
 
         abstract_batch = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), batch

@@ -45,6 +45,7 @@ class Optimizer(Capsule):
         tx_factory: Callable[..., optax.GradientTransformation] = optax.adamw,
         learning_rate: float = 1e-3,
         grad_clip_norm: Optional[float] = None,
+        wrap: Optional[Callable[[optax.GradientTransformation], optax.GradientTransformation]] = None,
         tag: str = "lr",
         statefull: bool = True,
         priority: int = 1000,
@@ -56,6 +57,7 @@ class Optimizer(Capsule):
         self._tx_factory = tx_factory
         self._learning_rate = learning_rate
         self._grad_clip_norm = grad_clip_norm
+        self._wrap = wrap
         self._tx_kwargs = tx_kwargs
         self._tag = tag
         self._iter_idx = 0
@@ -79,6 +81,10 @@ class Optimizer(Capsule):
             tx = self._tx_factory(lr, **self._tx_kwargs)
         if self._grad_clip_norm is not None:
             tx = optax.chain(optax.clip_by_global_norm(self._grad_clip_norm), tx)
+        if self._wrap is not None:
+            # e.g. models.lora.freeze_non_lora — base weights frozen,
+            # adapters train (the LoRA fine-tune contract).
+            tx = self._wrap(tx)
         return tx
 
     def constant_schedule(self) -> Callable[[int], Any]:

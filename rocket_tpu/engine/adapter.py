@@ -76,6 +76,24 @@ class FlaxModel(ModelAdapter):
         self._mutable_collections = (
             tuple(mutable_collections) if mutable_collections is not None else None
         )
+        self._mesh = None
+        self._rules = None
+
+    def configure(self, mesh, rules) -> None:
+        """Give the adapter the mesh/rules so activation-sharding
+        constraints inside the model (``parallel.context.constrain``)
+        resolve during tracing.  Called by Module.materialize."""
+        self._mesh = mesh
+        self._rules = rules
+
+    def _ctx(self):
+        from rocket_tpu.parallel.context import mesh_context
+
+        if self._mesh is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return mesh_context(self._mesh, self._rules)
 
     def _rngs(self, rng: jax.Array) -> Dict[str, jax.Array]:
         keys = jax.random.split(rng, len(self.rng_collections))
@@ -83,7 +101,8 @@ class FlaxModel(ModelAdapter):
 
     def init_variables(self, rng: jax.Array, batch: Any) -> Tuple[Any, Any]:
         init_rngs = dict(self._rngs(rng), params=rng)
-        variables = self.module.init(init_rngs, batch, train=False)
+        with self._ctx():
+            variables = self.module.init(init_rngs, batch, train=False)
         variables = dict(variables)
         params = variables.pop("params", {})
         mutable = variables
@@ -97,12 +116,13 @@ class FlaxModel(ModelAdapter):
         collections = self._mutable_collections or tuple(sorted(dict(mutable)))
         variables = {"params": params, **dict(mutable)}
         rngs = self._rngs(rng) if train else None
-        if train and collections:
-            batch_out, updated = self.module.apply(
-                variables, batch, train=True, rngs=rngs, mutable=list(collections)
-            )
-            return batch_out, dict(updated)
-        batch_out = self.module.apply(variables, batch, train=train, rngs=rngs)
+        with self._ctx():
+            if train and collections:
+                batch_out, updated = self.module.apply(
+                    variables, batch, train=True, rngs=rngs, mutable=list(collections)
+                )
+                return batch_out, dict(updated)
+            batch_out = self.module.apply(variables, batch, train=train, rngs=rngs)
         return batch_out, mutable
 
     def partition_specs(self, abstract_params: Any, rules: ShardingRules) -> Any:

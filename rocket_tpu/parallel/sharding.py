@@ -70,6 +70,11 @@ class ShardingRules:
         ("expert", "expert"),
         ("stage", "pipe"),
         ("norm", None),
+        ("layers", None),  # scan-stacked layer dim (never sharded)
+        # Activation-only axes: the residual stream's feature dim must NOT
+        # reuse the parameter 'embed' -> 'fsdp' mapping (the batch dim
+        # already occupies 'fsdp'; ZeRO shards params, not activations).
+        ("act_embed", None),
     )
 
     def table(self) -> Dict[str, MeshAxes]:
