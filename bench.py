@@ -1,0 +1,130 @@
+"""Benchmark: GPT-2 124M training-step throughput on the available chip.
+
+Prints ONE JSON line:
+``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``
+
+The workload is the BASELINE.json ladder's "GPT-2 124M LM" config driven
+through the framework's own jitted train step (Module + Loss + Optimizer →
+donated step), bf16 compute, flash attention.  Steps are timed with the
+state threaded sequentially (step i+1 consumes step i's state), so async
+dispatch / caching cannot fake the measurement; the final block waits on the
+whole chain.
+
+``vs_baseline``: the reference (dsenushkin/rocket) publishes NO benchmark
+numbers (BASELINE.json ``"published": {}``; SURVEY §6), so the ratio is
+against the BASELINE.json north-star proxy instead: 50% model-FLOPs
+utilization of the chip's peak — vs_baseline = MFU / 0.50.
+"""
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import rocket_tpu as rt  # noqa: E402
+from rocket_tpu.models.objectives import lm_cross_entropy  # noqa: E402
+from rocket_tpu.models.transformer import TransformerConfig, TransformerLM  # noqa: E402
+
+
+def peak_flops_per_chip() -> float:
+    """bf16 peak for the local accelerator (fallback: v5e)."""
+    kind = jax.devices()[0].device_kind.lower()
+    table = {
+        "v5 lite": 197e12, "v5e": 197e12,
+        "v4": 275e12,
+        "v5p": 459e12, "v5": 459e12,
+        "v6 lite": 918e12, "v6e": 918e12,
+        "v3": 123e12,
+        "v2": 45e12,
+    }
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return 197e12
+
+
+def step_flops(cfg: TransformerConfig, batch: int, seq: int) -> float:
+    """Training-step model FLOPs: 6 * params * tokens + attention term."""
+    n_params = (
+        cfg.vocab_size * cfg.hidden  # embed (tied head reuses it)
+        + cfg.max_seq * cfg.hidden  # learned positions
+        + cfg.n_layers * (
+            4 * cfg.hidden * cfg.hidden  # qkvo
+            + 2 * cfg.hidden * cfg.mlp_dim  # gelu mlp up+down
+            + 4 * cfg.hidden  # norms + biases (negligible)
+        )
+    )
+    tokens = batch * seq
+    dense = 6.0 * n_params * tokens
+    # attention scores+context: fwd 2*2*B*H*S^2*D, bwd ~2x
+    attn = 3.0 * 2.0 * 2.0 * batch * cfg.n_heads * seq * seq * cfg.head_dim
+    return dense + attn
+
+
+def main() -> None:
+    batch, seq = 8, 1024
+    cfg = TransformerConfig.gpt2_124m(attention="auto", remat=False)
+    model = TransformerLM(cfg)
+    runtime = rt.Runtime(mixed_precision="bf16")
+    module = rt.Module(
+        model,
+        capsules=[
+            rt.Loss(lm_cross_entropy(), name="lm"),
+            rt.Optimizer(learning_rate=1e-4),
+        ],
+    )
+    module.bind(runtime)
+    module.setup()
+
+    rng = np.random.default_rng(0)
+    batches = [
+        jax.device_put(
+            {"tokens": jnp.asarray(
+                rng.integers(0, cfg.vocab_size, size=(batch, seq)), jnp.int32
+            )},
+            runtime.batch_sharding(ndim=2),
+        )
+        for _ in range(4)
+    ]
+    attrs = rt.Attributes(
+        looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
+    )
+
+    # warmup (compile + 2 steps)
+    for i in range(3):
+        attrs.batch = batches[i % 4]
+        module.launch(attrs)
+    jax.block_until_ready(module.state.params)
+
+    n_steps = 20
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        attrs.batch = batches[i % 4]
+        module.launch(attrs)  # state threads: step i+1 depends on step i
+    jax.block_until_ready(module.state.params)
+    elapsed = time.perf_counter() - t0
+
+    step_time = elapsed / n_steps
+    tokens_per_sec = batch * seq / step_time
+    mfu = step_flops(cfg, batch, seq) / step_time / peak_flops_per_chip()
+    result = {
+        "metric": "gpt2-124m train throughput (1 chip, bf16, bs8x1024)",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(mfu / 0.50, 3),
+        "step_time_ms": round(step_time * 1e3, 2),
+        "mfu": round(mfu, 4),
+        "device": jax.devices()[0].device_kind,
+        "baseline_note": "reference publishes no numbers (BASELINE.json published={}); vs_baseline = MFU/0.50 north-star proxy",
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

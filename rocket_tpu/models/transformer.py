@@ -68,6 +68,8 @@ class TransformerConfig:
     use_bias: bool = False
     norm_eps: float = 1e-5
     attention: str = "auto"  # 'auto' | 'dot' | 'flash' | 'ring'
+    attention_block_q: int = 256
+    attention_block_k: int = 512
     causal: bool = True  # False -> bidirectional encoder (ViT)
     remat: bool = False
     scan_layers: bool = False
@@ -192,6 +194,8 @@ class Attention(nn.Module):
             impl=cfg.attention,
             causal=cfg.causal,
             segment_ids=segment_ids,
+            block_q=cfg.attention_block_q,
+            block_k=cfg.attention_block_k,
         )
         out = out.reshape(B, S, H * D)
         out = PDense(

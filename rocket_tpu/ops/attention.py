@@ -80,6 +80,8 @@ def attend(
     segment_ids: Optional[Array] = None,
     scale: Optional[float] = None,
     seq_axis: Optional[str] = None,
+    block_q: int = 256,
+    block_k: int = 512,
 ) -> Array:
     """Dispatch to an attention implementation.
 
@@ -97,7 +99,8 @@ def attend(
         from rocket_tpu.ops.flash import flash_attention
 
         return flash_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
+            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
+            block_q=block_q, block_k=block_k,
         )
     if impl == "ring":
         from rocket_tpu.ops.ring import ring_attention
