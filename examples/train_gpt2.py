@@ -1,0 +1,91 @@
+"""GPT-2 124M language-model training (BASELINE.json config #3).
+
+Demonstrates the LM pipeline: grad accumulation, cosine LR schedule with
+warmup, gradient clipping, checkpoint + resume, flash attention.  Data is a
+token file if given (``--data tokens.npy``: int32 ``[docs, seq]``), else a
+synthetic Markov stream so the script runs anywhere.
+
+    python examples/train_gpt2.py [--tiny] [--resume path/to/ckpt]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+import optax
+
+import rocket_tpu as rt
+from rocket_tpu.data.toys import synthetic_lm_tokens
+from rocket_tpu.models.objectives import lm_cross_entropy
+from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tiny", action="store_true", help="tiny config (CPU-friendly)")
+    parser.add_argument("--data", type=str, default=None, help="int32 [docs, seq] .npy")
+    parser.add_argument("--resume", type=str, default=None)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--accum", type=int, default=2)
+    args = parser.parse_args()
+
+    if args.data:
+        data = {"tokens": np.load(args.data).astype(np.int32)}
+        vocab = int(data["tokens"].max()) + 1
+        cfg = TransformerConfig.gpt2_124m()
+        assert vocab <= cfg.vocab_size
+    elif args.tiny:
+        cfg = TransformerConfig.tiny(
+            norm="layernorm", mlp="gelu", positions="learned",
+            tie_embeddings=True, use_bias=True,
+        )
+        data = synthetic_lm_tokens(n_docs=256, seq_len=128, vocab=cfg.vocab_size)
+    else:
+        cfg = TransformerConfig.gpt2_124m()
+        data = synthetic_lm_tokens(n_docs=256, seq_len=512, vocab=512)
+
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0, peak_value=3e-4, warmup_steps=20,
+        decay_steps=500, end_value=3e-5,
+    )
+    model = rt.Module(
+        TransformerLM(cfg),
+        capsules=[
+            rt.Loss(lm_cross_entropy(), name="lm"),
+            rt.Optimizer(
+                tx_factory=optax.adamw, learning_rate=3e-4,
+                grad_clip_norm=1.0, weight_decay=0.1,
+            ),
+            rt.Scheduler(schedule),
+        ],
+    )
+    launcher = rt.Launcher(
+        capsules=[
+            rt.Looper(
+                capsules=[
+                    rt.Dataset(
+                        rt.ArraySource(data), batch_size=args.batch, shuffle=True
+                    ),
+                    model,
+                    rt.Tracker("jsonl"),
+                    rt.Checkpointer(save_every=50, keep_last=2),
+                ]
+            )
+        ],
+        tag="gpt2",
+        num_epochs=args.epochs,
+        mixed_precision="bf16",
+        gradient_accumulation_steps=args.accum,
+    )
+    if args.resume:
+        launcher.resume(args.resume)
+    launcher.launch()
+    print(f"done: {model.step} optimizer steps")
+
+
+if __name__ == "__main__":
+    main()

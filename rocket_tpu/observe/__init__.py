@@ -6,6 +6,7 @@ from rocket_tpu.observe.backends import (
 )
 from rocket_tpu.utils.logging import RankAwareLogger, get_logger
 from rocket_tpu.observe.meter import Meter, Metric
+from rocket_tpu.observe.profile import Profiler, Throughput, annotate, debug_mode
 from rocket_tpu.observe.tracker import Tracker
 
 __all__ = [
@@ -13,6 +14,10 @@ __all__ = [
     "MemoryBackend",
     "Meter",
     "Metric",
+    "Profiler",
+    "Throughput",
+    "annotate",
+    "debug_mode",
     "RankAwareLogger",
     "TensorBoardBackend",
     "Tracker",

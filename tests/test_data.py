@@ -1,0 +1,103 @@
+"""Data-layer unit tests: sources, collate, padding masks, determinism."""
+
+import numpy as np
+import pytest
+
+from rocket_tpu.data import ArraySource, ConcatSource, DataLoader, MapSource
+from rocket_tpu.data.toys import mnist, synthetic_lm_tokens, synthetic_mnist
+
+
+def _source(n=10):
+    return ArraySource(
+        {"x": np.arange(n * 3, dtype=np.float32).reshape(n, 3),
+         "y": np.arange(n, dtype=np.int32)}
+    )
+
+
+class TestSources:
+    def test_array_source(self):
+        src = _source()
+        assert len(src) == 10
+        sample = src[2]
+        np.testing.assert_array_equal(sample["x"], [6, 7, 8])
+        assert sample["y"] == 2
+
+    def test_array_source_mismatched_lengths(self):
+        with pytest.raises(ValueError, match="leading dim"):
+            ArraySource({"a": np.zeros(3), "b": np.zeros(4)})
+
+    def test_map_source(self):
+        src = MapSource(_source(), lambda s: {**s, "y2": s["y"] * 2})
+        assert src[3]["y2"] == 6
+
+    def test_concat_source(self):
+        src = ConcatSource([_source(4), _source(6)])
+        assert len(src) == 10
+        assert src[4]["y"] == 0  # first item of second source
+        assert src[-1]["y"] == 5
+
+
+class TestLoader:
+    def test_batching_and_padding_mask(self):
+        # 10 samples, batch 4 -> 3 batches, last padded with 2 wrap-around rows
+        loader = DataLoader(_source(10), batch_size=4)
+        batches = list(loader.iterate())
+        assert len(batches) == 3
+        assert all(b["x"].shape == (4, 3) for b in batches)  # static shapes
+        np.testing.assert_array_equal(
+            np.asarray(batches[-1]["_valid"]), [True, True, False, False]
+        )
+        # wrap-around pad repeats the epoch head
+        np.testing.assert_array_equal(
+            np.asarray(batches[-1]["y"])[2:], [0, 1]
+        )
+
+    def test_drop_last(self):
+        loader = DataLoader(_source(10), batch_size=4, drop_last=True)
+        assert len(loader) == 2
+        assert len(list(loader.iterate())) == 2
+
+    def test_shuffle_determinism_per_epoch(self):
+        loader = DataLoader(_source(32), batch_size=8, shuffle=True, seed=1)
+        a = [np.asarray(b["y"]) for b in loader.iterate(epoch=2)]
+        b = [np.asarray(b["y"]) for b in loader.iterate(epoch=2)]
+        c = [np.asarray(b["y"]) for b in loader.iterate(epoch=3)]
+        for x, y in zip(a, b):
+            np.testing.assert_array_equal(x, y)
+        assert any(not np.array_equal(x, y) for x, y in zip(a, c))
+
+    def test_prefetch_equals_sync(self):
+        loader_a = DataLoader(_source(20), batch_size=4, prefetch=0)
+        loader_b = DataLoader(_source(20), batch_size=4, prefetch=3)
+        for x, y in zip(loader_a.iterate(), loader_b.iterate()):
+            np.testing.assert_array_equal(np.asarray(x["y"]), np.asarray(y["y"]))
+
+    def test_producer_error_propagates(self):
+        class Bad(ArraySource):
+            def __getitem__(self, i):
+                if i == 5:
+                    raise RuntimeError("boom")
+                return super().__getitem__(i)
+
+        loader = DataLoader(
+            Bad({"x": np.zeros((8, 2), np.float32)}), batch_size=4, prefetch=2
+        )
+        with pytest.raises(RuntimeError, match="boom"):
+            list(loader.iterate())
+
+
+class TestToys:
+    def test_synthetic_mnist_shapes(self):
+        train, test = synthetic_mnist(n_train=64, n_test=16)
+        assert train["image"].shape == (64, 28, 28, 1)
+        assert train["image"].dtype == np.float32
+        assert train["label"].max() <= 9
+
+    def test_mnist_falls_back_to_synthetic(self):
+        train, _ = mnist(n_train=32, n_test=8)
+        assert train["image"].shape[0] == 32
+
+    def test_lm_tokens_structure(self):
+        data = synthetic_lm_tokens(n_docs=8, seq_len=32, vocab=64)
+        assert data["tokens"].shape == (8, 32)
+        assert data["tokens"].max() < 64

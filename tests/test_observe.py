@@ -1,0 +1,75 @@
+"""Observability tests: tracker flush protocol, throughput meter, backends."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import rocket_tpu as rt
+from rocket_tpu.observe import JsonlBackend, MemoryBackend, Throughput
+from rocket_tpu.observe.backends import resolve_backend
+
+
+class TestTracker:
+    def _tracked(self, flush_every=2):
+        backend = MemoryBackend()
+        tracker = rt.Tracker(backend, flush_every=flush_every)
+        runtime = rt.Runtime()
+        tracker.bind(runtime)
+        tracker.setup()
+        return tracker, backend
+
+    def test_buffered_flush_cadence(self):
+        tracker, backend = self._tracked(flush_every=3)
+        attrs = rt.Attributes()
+        tracker.set(attrs)
+        for step in range(5):
+            attrs.tracker.scalars.append(
+                rt.Attributes(step=step, data={"loss": float(step)})
+            )
+            tracker.launch(attrs)
+        # flushed once at step 3; 2 records still buffered
+        assert len(backend.scalars) == 3
+        tracker.reset(attrs)  # final flush + drop buffers
+        assert len(backend.scalars) == 5
+        assert attrs.tracker is None
+
+    def test_backend_shared_via_runtime_registry(self):
+        backend = MemoryBackend()
+        runtime = rt.Runtime()
+        t1 = rt.Tracker(backend)
+        t2 = rt.Tracker(backend)
+        for t in (t1, t2):
+            t.bind(runtime)
+            t.setup()
+        assert t1._backend is t2._backend
+
+    def test_jsonl_backend(self, tmp_path):
+        backend = JsonlBackend(str(tmp_path))
+        backend.log_scalars({"a": 1.5}, step=7)
+        backend.close()
+        line = json.loads(open(tmp_path / "metrics.jsonl").read().strip())
+        assert line["a"] == 1.5 and line["step"] == 7
+
+    def test_resolve_backend_needs_project_dir(self):
+        with pytest.raises(RuntimeError, match="project dir"):
+            resolve_backend("tensorboard", None)
+        with pytest.raises(ValueError, match="unknown tracker backend"):
+            resolve_backend("wandb-nope", "/tmp")
+
+
+class TestThroughput:
+    def test_rate_published_to_loop_state(self):
+        tp = Throughput(ema=0.0, log_every=2)
+        attrs = rt.Attributes(
+            batch={"x": np.zeros((16, 2))},
+            looper=rt.Attributes(state=rt.Attributes()),
+            tracker=rt.Attributes(scalars=[], images=[]),
+        )
+        tp.set(attrs)
+        for _ in range(4):
+            tp.launch(attrs)
+        assert "throughput" in attrs.looper.state
+        tags = [t for rec in attrs.tracker.scalars for t in rec.data]
+        assert "throughput/samples_per_sec" in tags
