@@ -268,6 +268,7 @@ class Module(Dispatcher):
                 str(spec.path), self._ckpt_key, target={"state": target}
             )
             self._state = restored["state"]
+            self._sync_micro_idx()
             self._logger.info("restored full module state from %s", spec.path)
             return
         # Weights-only (reference ``launcher.py:349-359``): restore params +
@@ -301,7 +302,10 @@ class Module(Dispatcher):
         batch = attrs.batch
         if batch is None:
             return  # upstream Dataset exhausted / skipped
-        if self._state is None:
+        if self._state is None or self._eval_step is None:
+            # No eval step ⇒ steps were never built for this state (e.g. the
+            # state arrived via load_state_dict); materialize keeps an
+            # existing state and (re)builds the jitted steps.
             self.materialize(batch)
 
         looper = attrs.looper
@@ -352,6 +356,17 @@ class Module(Dispatcher):
         # direct host-side pytree (single-host tests) is also accepted.
         if state and "state" in state:
             self._state = state["state"]
+            self._sync_micro_idx()
+
+    def _sync_micro_idx(self) -> None:
+        """Re-derive the host-side accumulation-window position from the
+        restored TrainState so a resume that lands mid-window re-enters the
+        window where it left off (``state.micro`` is the saved counterpart
+        of ``_micro_idx``: +1 per micro step, reset to 0 at each sync)."""
+        if self._state is not None and self._state.micro is not None:
+            self._micro_idx = int(self._state.micro) % self._accum
+        else:
+            self._micro_idx = 0
 
 
 def _null_tx():

@@ -78,6 +78,14 @@ def lm_cross_entropy(
             mask = batch.get(mask_key)
         if mask is not None:
             mask = mask[:, 1:].astype(losses.dtype)
+        # AND in the loader's per-row padding mask so wrap-around rows of the
+        # final partial batch (drop_last=False) don't count double.
+        valid = batch.get("_valid") if hasattr(batch, "get") else None
+        if valid is not None:
+            valid = valid.astype(losses.dtype)[:, None]
+            mask = valid if mask is None else mask * valid
+        if mask is not None:
+            mask = jnp.broadcast_to(mask, losses.shape)
             total = jnp.maximum(mask.sum(), 1.0)
             return (losses * mask).sum() / total
         return losses.mean()

@@ -14,6 +14,7 @@ is CPU-runnable.
 
 from __future__ import annotations
 
+import functools as _functools
 import pickle
 from typing import Any, Optional
 
@@ -141,21 +142,30 @@ def assert_equal(value: Any, fail_message: str = "") -> None:
     multihost_utils.assert_equal(value, fail_message)
 
 
-def _local_rows(value: Any) -> np.ndarray:
-    """This host's unique dim-0 rows of a sharded array, in index order.
-    Shards replicated over non-data mesh axes carry identical dim-0 slices —
-    keep one per distinct slice (dedup), or metrics would count every sample
-    once per model/tensor-parallel replica."""
-    seen = set()
-    picked = []
-    for shard in value.addressable_shards:
-        start = (shard.index[0].start or 0) if shard.index else 0
-        if start in seen:
-            continue
-        seen.add(start)
-        picked.append((start, shard))
-    picked.sort(key=lambda pair: pair[0])
-    return np.concatenate([np.asarray(s.data) for _, s in picked], axis=0)
+@_functools.lru_cache(maxsize=64)
+def _replicate_fn(out_shardings: tuple):
+    # One stable jitted identity per sharding signature: a fresh lambda per
+    # call would miss jax's function-keyed executable cache and recompile on
+    # every eval iteration.
+    return jax.jit(lambda *xs: xs, out_shardings=out_shardings)
+
+
+def _replicate_on_mesh(leaves: list) -> list:
+    """All-gather arbitrarily-sharded global arrays to full replication.
+
+    A jitted identity with replicated ``out_shardings`` makes XLA insert the
+    all-gathers (ICI within a slice, DCN across) for the WHOLE tree in one
+    compiled program; the result is fully addressable on every host.  This
+    handles any ``PartitionSpec`` — including leaves sharded along non-leading
+    dims (e.g. logits on the tensor axis), which a per-shard row concat
+    cannot reassemble correctly."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    out_sh = tuple(
+        NamedSharding(leaf.sharding.mesh, PartitionSpec()) for leaf in leaves
+    )
+    replicated = _replicate_fn(out_sh)(*leaves)
+    return [np.asarray(leaf) for leaf in replicated]
 
 
 def to_host_global(value: Any) -> Any:
@@ -165,25 +175,21 @@ def to_host_global(value: Any) -> Any:
     the caller's valid-mask job (SURVEY §7.4).
 
     Fully-addressable arrays (single host, or replicated outputs) are just
-    device_get; cross-host sharded leaves are gathered over DCN in ONE
-    collective for the whole tree.
+    device_get; cross-host sharded leaves are replicated over the mesh in ONE
+    compiled collective program for the whole tree.
     """
     leaves, treedef = jax.tree_util.tree_flatten(value)
     out = [None] * len(leaves)
-    pending = {}  # leaf position -> host-local rows
+    pending = {}  # leaf position -> global sharded array
     for i, leaf in enumerate(leaves):
         if not hasattr(leaf, "addressable_shards") or getattr(
             leaf, "is_fully_addressable", True
         ):
             out[i] = np.asarray(leaf)
         else:
-            pending[i] = _local_rows(leaf)
+            pending[i] = leaf
     if pending:
-        from jax.experimental import multihost_utils
-
-        gathered = multihost_utils.process_allgather(
-            list(pending.values()), tiled=True
-        )
+        gathered = _replicate_on_mesh(list(pending.values()))
         for pos, host_global in zip(pending.keys(), gathered):
-            out[pos] = np.asarray(host_global)
+            out[pos] = host_global
     return jax.tree_util.tree_unflatten(treedef, out)

@@ -60,11 +60,71 @@ class Checkpointer(Capsule):
                 "Checkpointer needs a project dir — give the Launcher a tag "
                 "(reference checkpoint.py:75-81)"
             )
+        # Seed retention from snapshots already on disk so keep_last keeps
+        # bounding disk after a restart (in-memory-only tracking forgets
+        # pre-crash snapshots).  A FULL resume is a continuation of the prior
+        # run, so its snapshot dir joins the retention window too; a
+        # weights-only resume is a new run seeded from pretrained weights —
+        # never delete those.
+        self._saved_dirs = []
+        spec = getattr(self._runtime, "resume_spec", None)
+        if spec is not None and spec.load_capsules:
+            prior_root = self._strip_format(str(spec.path))
+            if prior_root is not None and prior_root != self._runtime.project_dir:
+                self._saved_dirs += self._snapshots_under(prior_root)
+        self._saved_dirs += self._snapshots_under(self._runtime.project_dir)
+
+    def _format_parts(self):
+        import re
+
+        field = re.search(r"\{[^}]*\}", self._format)
+        if field is None:
+            return None
+        return self._format[: field.start()], self._format[field.end():]
+
+    def _strip_format(self, snapshot_path: str):
+        """Invert output_dir_format: the project root a snapshot was written
+        under, or None if the path doesn't match the format."""
+        import re
+
+        parts = self._format_parts()
+        if parts is None:
+            return None
+        prefix, suffix = parts
+        tail = re.compile(
+            re.escape(os.sep) + re.escape(prefix) + r"\d+" + re.escape(suffix) + r"$"
+        )
+        match = tail.search(snapshot_path)
+        if match is None:
+            return None
+        return snapshot_path[: match.start()]
+
+    def _snapshots_under(self, root: str) -> list:
+        """Snapshot dirs under ``root`` matching output_dir_format, ordered
+        by iteration index."""
+        import glob
+        import re
+
+        parts = self._format_parts()
+        if parts is None:
+            path = os.path.join(root, self._format)
+            return [path] if os.path.isdir(path) else []
+        prefix, suffix = parts
+        pattern = re.compile(re.escape(prefix) + r"(\d+)" + re.escape(suffix) + r"$")
+        found = []
+        for dirpath in glob.glob(os.path.join(root, prefix + "*" + suffix)):
+            match = pattern.match(os.path.relpath(dirpath, root))
+            if match and os.path.isdir(dirpath):
+                found.append((int(match.group(1)), dirpath))
+        found.sort()
+        return [p for _, p in found]
 
     # -- cycle ---------------------------------------------------------------
 
     def launch(self, attrs: Optional[Attributes] = None) -> None:
-        if self._iter_idx % self._save_every == 0:
+        # (idx + 1) cadence: first save after save_every iterations, not a
+        # useless step-0 snapshot (reference checkpoint.py:116-120 semantics).
+        if (self._iter_idx + 1) % self._save_every == 0:
             self.save()
         self._iter_idx += 1
 
@@ -94,6 +154,8 @@ class Checkpointer(Capsule):
             return path
         default_io().save(path, items, force=True)
         self._logger.info("checkpoint -> %s", path)
+        # Retention across restarts comes from the setup() disk scan, not
+        # from persisting this list.
         self._saved_dirs.append(path)
         self._prune()
         return path
