@@ -184,6 +184,9 @@ class Module(Dispatcher):
         configure = getattr(self._adapter, "configure", None)
         if configure is not None:
             configure(mesh, runtime.rules)
+        apply_policy = getattr(self._adapter, "apply_policy", None)
+        if apply_policy is not None:
+            apply_policy(policy)
 
         abstract_batch = jax.tree_util.tree_map(
             lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), batch

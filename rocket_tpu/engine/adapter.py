@@ -86,6 +86,16 @@ class FlaxModel(ModelAdapter):
         self._mesh = mesh
         self._rules = rules
 
+    def apply_policy(self, policy) -> None:
+        """Thread the precision policy's compute dtype into modules exposing
+        a ``dtype`` attribute left at ``None`` (the vision model families):
+        they cast their own input leaves to it, which keeps uint8 loaders
+        honest under bf16 without the engine touching supervision targets.
+        Called by Module.materialize before init."""
+        module = self.module
+        if getattr(module, "dtype", "absent") is None:
+            self.module = module.clone(dtype=policy.compute_dtype)
+
     def _ctx(self):
         from rocket_tpu.parallel.context import mesh_context
 

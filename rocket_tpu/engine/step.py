@@ -97,6 +97,11 @@ def build_loss_fn(
     with the precision policy applied around the forward pass."""
 
     def loss_fn(params, mutable, rng, batch):
+        # Autocast analogue (reference ``module.py:210``): params enter the
+        # model in the compute dtype; the model families cast their own
+        # INPUT leaves (images/tokens) to it.  The batch itself is NOT cast —
+        # supervision targets and masks must keep full precision for the
+        # objectives.
         compute_params = policy.cast_to_compute(params)
         batch_out, new_mutable = apply_fn(compute_params, mutable, rng, batch, True)
         total, logs = _total_loss(objectives, batch_out)

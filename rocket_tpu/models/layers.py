@@ -24,6 +24,18 @@ def _init(fn, *logical: Optional[str]):
     return nn.with_partitioning(fn, logical)
 
 
+def image_input(x: jax.Array, dtype: Any = None) -> jax.Array:
+    """Cast an image batch leaf to the model's compute dtype.
+
+    ``dtype=None`` (no policy threaded): raw integer images become f32,
+    floats keep their dtype.  With a policy compute dtype (the Module clones
+    vision models with ``dtype=policy.compute_dtype``), both integer and
+    float images land in it — so uint8 loaders get honest bf16 too."""
+    if dtype is None:
+        dtype = jnp.float32 if jnp.issubdtype(x.dtype, jnp.integer) else x.dtype
+    return x.astype(dtype)
+
+
 class RMSNorm(nn.Module):
     """Root-mean-square layer norm (Llama-family norm)."""
 
@@ -98,7 +110,7 @@ class Embed(nn.Module):
 
     vocab_size: int
     features: int
-    dtype: Any = jnp.float32
+    dtype: Any = None  # None = the table's own dtype (the policy casts it)
 
     def setup(self):
         self.embedding = self.param(
@@ -108,13 +120,19 @@ class Embed(nn.Module):
         )
 
     def __call__(self, tokens):
-        table = jnp.asarray(self.embedding, self.dtype)
+        # The precision policy casts params to the compute dtype before
+        # apply, so the table's dtype IS the compute dtype — pinning f32
+        # here would silently upcast the whole residual stream (every
+        # downstream PDense follows activation dtype).
+        table = self.embedding
+        if self.dtype is not None:
+            table = jnp.asarray(table, self.dtype)
         if self._vocab_sharded():
             # One-hot matmul instead of gather: a gather from a
             # vocab-sharded table forces XLA into a full rematerialization
             # (replicate-then-reshard); the matmul shards cleanly and rides
             # the MXU — the standard TPU embedding trick.
-            one_hot = jax.nn.one_hot(tokens, self.vocab_size, dtype=self.dtype)
+            one_hot = jax.nn.one_hot(tokens, self.vocab_size, dtype=table.dtype)
             return one_hot @ table
         return table[tokens]
 

@@ -35,7 +35,9 @@ def cross_entropy(
     ``examples/mnist.py:81-87``)."""
 
     def fn(batch: Any) -> jnp.ndarray:
-        logits = batch[logits_key]
+        # f32 softmax regardless of compute dtype (bf16 logits are fine on
+        # the matmuls; the log-sum-exp wants f32).
+        logits = batch[logits_key].astype(jnp.float32)
         labels = batch[labels_key]
         if label_smoothing > 0.0:
             num_classes = logits.shape[-1]
@@ -54,7 +56,10 @@ def cross_entropy(
 
 def mse(pred_key: str = "pred", target_key: str = "target") -> Callable[[Any], Any]:
     def fn(batch: Any) -> jnp.ndarray:
-        err = (batch[pred_key] - batch[target_key]) ** 2
+        err = (
+            batch[pred_key].astype(jnp.float32)
+            - batch[target_key].astype(jnp.float32)
+        ) ** 2
         per_sample = err.reshape(err.shape[0], -1).mean(axis=-1)
         return _masked_mean(per_sample, batch)
 
@@ -70,7 +75,7 @@ def lm_cross_entropy(
     optional per-token mask (padding / prompt masking)."""
 
     def fn(batch: Any):
-        logits = batch[logits_key][:, :-1]
+        logits = batch[logits_key][:, :-1].astype(jnp.float32)
         targets = batch[tokens_key][:, 1:]
         losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
         mask = None

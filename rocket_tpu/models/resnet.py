@@ -19,29 +19,29 @@ import flax.linen as nn
 import jax.numpy as jnp
 
 from rocket_tpu.core.attributes import Attributes
-
-Conv = partial(nn.Conv, use_bias=False, dtype=jnp.float32)
+from rocket_tpu.models.layers import image_input
 
 
 class BottleneckBlock(nn.Module):
     features: int
     strides: Tuple[int, int] = (1, 1)
     norm: Any = None
+    conv: Any = None
 
     @nn.compact
     def __call__(self, x):
-        norm = self.norm
+        norm, conv = self.norm, self.conv
         residual = x
-        y = Conv(self.features, (1, 1))(x)
+        y = conv(self.features, (1, 1))(x)
         y = norm()(y)
         y = nn.relu(y)
-        y = Conv(self.features, (3, 3), strides=self.strides)(y)
+        y = conv(self.features, (3, 3), strides=self.strides)(y)
         y = norm()(y)
         y = nn.relu(y)
-        y = Conv(self.features * 4, (1, 1))(y)
+        y = conv(self.features * 4, (1, 1))(y)
         y = norm(scale_init=nn.initializers.zeros_init())(y)
         if residual.shape != y.shape:
-            residual = Conv(self.features * 4, (1, 1), strides=self.strides)(
+            residual = conv(self.features * 4, (1, 1), strides=self.strides)(
                 residual
             )
             residual = norm()(residual)
@@ -57,20 +57,26 @@ class ResNet(nn.Module):
     small_images: bool = False  # CIFAR stem (3x3, no maxpool)
     image_key: str = "image"
     logits_key: str = "logits"
+    # Compute dtype; None = follow the input. The Module clones this in from
+    # the precision policy at materialization (honest bf16, VERDICT r1 #5).
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, batch, train: bool = False):
-        x = batch[self.image_key].astype(jnp.float32)
+        x = image_input(batch[self.image_key], self.dtype)
+        cdtype = x.dtype
+        conv = partial(nn.Conv, use_bias=False, dtype=cdtype)
         norm = partial(
             nn.BatchNorm,
             use_running_average=not train,
             momentum=0.9,
             epsilon=1e-5,
+            dtype=cdtype,
         )
         if self.small_images:
-            x = Conv(self.width, (3, 3))(x)
+            x = conv(self.width, (3, 3))(x)
         else:
-            x = Conv(self.width, (7, 7), strides=(2, 2))(x)
+            x = conv(self.width, (7, 7), strides=(2, 2))(x)
         x = norm()(x)
         x = nn.relu(x)
         if not self.small_images:
@@ -79,10 +85,10 @@ class ResNet(nn.Module):
             for block in range(size):
                 strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
                 x = BottleneckBlock(
-                    self.width * 2 ** stage, strides=strides, norm=norm
+                    self.width * 2 ** stage, strides=strides, norm=norm, conv=conv
                 )(x)
         x = jnp.mean(x, axis=(1, 2))
-        logits = nn.Dense(self.num_classes)(x)
+        logits = nn.Dense(self.num_classes, dtype=cdtype)(x)
         out = Attributes(batch)
         out[self.logits_key] = logits
         return out

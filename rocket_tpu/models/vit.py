@@ -11,11 +11,13 @@ Batch contract: reads ``batch['image']`` (NHWC), writes ``batch['logits']``.
 from __future__ import annotations
 
 import dataclasses
+from typing import Any
 
 import flax.linen as nn
 import jax.numpy as jnp
 
 from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.models.layers import image_input
 from rocket_tpu.models.transformer import Block, TransformerConfig, _Norm
 from rocket_tpu.parallel.context import constrain
 
@@ -68,30 +70,36 @@ class ViT(nn.Module):
     config: ViTConfig
     image_key: str = "image"
     logits_key: str = "logits"
+    # Compute dtype; None = follow the input. The Module clones this in from
+    # the precision policy at materialization (honest bf16, VERDICT r1 #5).
+    dtype: Any = None
 
     @nn.compact
     def __call__(self, batch, train: bool = False):
         cfg = self.config
         enc = cfg.encoder_config()
-        x = batch[self.image_key].astype(jnp.float32)
+        x = image_input(batch[self.image_key], self.dtype)
+        cdtype = x.dtype
         B = x.shape[0]
         x = nn.Conv(
             cfg.hidden,
             kernel_size=(cfg.patch_size, cfg.patch_size),
             strides=(cfg.patch_size, cfg.patch_size),
             padding="VALID",
+            dtype=cdtype,
             name="patchify",
         )(x)
         x = x.reshape(B, -1, cfg.hidden)  # [B, patches, hidden]
         cls_token = self.param(
             "cls", nn.initializers.zeros_init(), (1, 1, cfg.hidden)
         )
+        cls_token = cls_token.astype(cdtype)
         x = jnp.concatenate([jnp.broadcast_to(cls_token, (B, 1, cfg.hidden)), x], 1)
         S = x.shape[1]
         pos = self.param(
             "pos_embedding", nn.initializers.normal(0.02), (1, S, cfg.hidden)
         )
-        x = x + pos
+        x = x + pos.astype(cdtype)
         if cfg.dropout and train:
             x = nn.Dropout(cfg.dropout, deterministic=False)(x)
         x = constrain(x, "batch", "sequence", "act_embed")
@@ -104,7 +112,7 @@ class ViT(nn.Module):
             x = block(x, positions, None, train)
 
         x = _Norm(enc, name="ln_f")(x)
-        logits = nn.Dense(cfg.num_classes, name="head")(x[:, 0])
+        logits = nn.Dense(cfg.num_classes, dtype=cdtype, name="head")(x[:, 0])
         out = Attributes(batch)
         out[self.logits_key] = logits
         return out

@@ -23,6 +23,32 @@ import numpy as np
 
 
 _initialized = False
+_degraded = False  # pod detected but rendezvous skipped (backends existed)
+
+
+def _in_pod_environment() -> bool:
+    """True when this process runs under a MULTI-host accelerator runtime
+    whose coordination parameters jax can auto-detect: a Cloud TPU pod VM
+    (>1 workers), multislice, or SLURM/OpenMPI with >1 tasks.  These are the
+    environments where ``jax.distributed.initialize()`` with no arguments
+    resolves coordinator/process_id itself.  Single-worker variants of the
+    same markers (a lone TPU VM sets ``TPU_WORKER_HOSTNAMES=localhost``) are
+    NOT pods — rendezvous there is pointless and, after backends exist,
+    fatal."""
+    import os
+
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h.strip()]) > 1:
+        return True
+    if "MEGASCALE_COORDINATOR_ADDRESS" in os.environ:
+        return True  # multislice is multi-host by definition
+    for count_var in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE"):
+        try:
+            if int(os.environ.get(count_var, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
 
 
 def initialize(
@@ -34,6 +60,22 @@ def initialize(
     runs).  Must be called before the first JAX computation — it therefore
     performs NO jax calls itself before ``jax.distributed.initialize``.
 
+    Resolution order:
+
+    1. explicit ``coordinator_address`` argument (or
+       ``JAX_COORDINATOR_ADDRESS`` env) → ``jax.distributed.initialize``
+       with explicit parameters;
+    2. a detected pod environment (TPU VM / GKE / SLURM / MPI) →
+       ``jax.distributed.initialize()`` with **no** arguments, letting jax
+       auto-detect coordinator, process count and id;
+    3. otherwise: single-process run, no-op.
+
+    Orbax **async** checkpointing on multi-host runs depends on the
+    distributed KV store this call creates — skipping it would silently
+    de-coordinate async saves (every host must reach the same commit
+    barrier).  The Launcher calls this at setup; call it earlier yourself
+    if you need collectives before ``launch()``.
+
     Reference analogue: process-group init inside ``Accelerator()``
     (``launcher.py:185-193``) / ``notebook_launcher`` (``launcher.py:239``).
     """
@@ -44,9 +86,12 @@ def initialize(
 
     if coordinator_address is None:
         coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
-    if coordinator_address is None:
-        return  # single-process run (or TPU runtime pre-wired via env)
-    kwargs = dict(coordinator_address=coordinator_address)
+    if coordinator_address is None and not _in_pod_environment():
+        return  # single-process run
+    # Honor every explicitly-given parameter; jax auto-detects the rest.
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
     if num_processes is not None:
         kwargs["num_processes"] = num_processes
     if process_id is not None:
@@ -55,8 +100,26 @@ def initialize(
         jax.distributed.initialize(**kwargs)
     except RuntimeError as err:
         text = str(err)
-        if "already initialized" in text:
-            pass  # someone (launcher/runtime) beat us to it — fine
+        if "already initialized" in text or "only be called once" in text:
+            pass  # someone (user code/runtime) beat us to it — fine
+        elif "must be called before" in text and "coordinator_address" not in kwargs:
+            # Auto-detect path, but jax backends already exist (e.g. a
+            # notebook that touched devices first).  Degrade: keep running
+            # single-process rather than kill the run; async multi-host
+            # checkpointing will not be coordinated.  _degraded marks this
+            # so the call stays idempotent and shutdown() stays a no-op.
+            import warnings
+
+            warnings.warn(
+                "multihost.initialize(): pod environment detected but JAX "
+                "backends are already initialized — skipping rendezvous. "
+                "Call rocket_tpu.parallel.multihost.initialize() before any "
+                "jax.devices()/computation for multi-host coordination."
+            )
+            global _degraded
+            _degraded = True
+            _initialized = True
+            return
         else:
             raise
     _initialized = True
@@ -64,10 +127,11 @@ def initialize(
 
 def shutdown() -> None:
     """Tear down the multi-host runtime (reference ``launcher.py:289-291``)."""
-    global _initialized
-    if _initialized:
+    global _initialized, _degraded
+    if _initialized and not _degraded:
         jax.distributed.shutdown()
-        _initialized = False
+    _initialized = False
+    _degraded = False
 
 
 def process_index() -> int:
