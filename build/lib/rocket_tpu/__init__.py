@@ -21,7 +21,7 @@ from rocket_tpu.core import (
 )
 from rocket_tpu.data import ArraySource, DataLoader, Dataset
 from rocket_tpu.launch import Launcher, Looper
-from rocket_tpu.observe import Accuracy, ImageLogger, Meter, Metric, StatMetric, Tracker
+from rocket_tpu.observe import Meter, Metric, Tracker
 from rocket_tpu.persist import Checkpointer
 from rocket_tpu.runtime import Runtime
 
@@ -39,11 +39,8 @@ __all__ = [
     "Launcher",
     "Looper",
     "Loss",
-    "Accuracy",
-    "ImageLogger",
     "Meter",
     "Metric",
-    "StatMetric",
     "Module",
     "Optimizer",
     "Runtime",

@@ -1,0 +1,21 @@
+"""Core capsule protocol (reference ``rocket/core/__init__.py:1-12``)."""
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.core.dispatcher import Dispatcher
+from rocket_tpu.core.events import Events
+from rocket_tpu.core.loss import Loss
+from rocket_tpu.core.module import Module
+from rocket_tpu.core.optimizer import Optimizer
+from rocket_tpu.core.scheduler import Scheduler
+
+__all__ = [
+    "Attributes",
+    "Capsule",
+    "Dispatcher",
+    "Events",
+    "Loss",
+    "Module",
+    "Optimizer",
+    "Scheduler",
+]

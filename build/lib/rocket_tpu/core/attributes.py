@@ -1,0 +1,94 @@
+"""Attributes — the inter-capsule blackboard.
+
+A ``dict`` subclass with attribute-style access where *missing keys read as
+``None``* instead of raising.  This is the single data bus through which
+capsules communicate: well-known keys are ``attrs.batch`` (the current batch /
+model outputs), ``attrs.looper`` (iteration-loop protocol), ``attrs.launcher``
+(run topology), ``attrs.tracker`` (buffered log records), plus arbitrary user
+keys.
+
+Capability parity: reference ``rocket/core/capsule.py:23-35`` (``Attributes =
+adict``).  Re-implemented from scratch — the semantics we preserve are
+(a) dot read of a missing key -> ``None``, (b) dot write/delete mutate the
+mapping, (c) nested plain dicts are promoted to ``Attributes`` so chained dot
+access works.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+
+class Attributes(dict):
+    """Dot-access dictionary blackboard; missing attribute reads return ``None``."""
+
+    def __init__(self, *args: Any, **kwargs: Any) -> None:
+        super().__init__(*args, **kwargs)
+        for key, value in list(self.items()):
+            if isinstance(value, dict) and not isinstance(value, Attributes):
+                super().__setitem__(key, Attributes(value))
+
+    # -- attribute protocol -------------------------------------------------
+
+    def __getattr__(self, key: str) -> Any:
+        # Dunder lookups must keep normal semantics (pickling, copy, etc.).
+        if key.startswith("__") and key.endswith("__"):
+            raise AttributeError(key)
+        return self.get(key)
+
+    def __setattr__(self, key: str, value: Any) -> None:
+        self[key] = value
+
+    def __delattr__(self, key: str) -> None:
+        self.pop(key, None)
+
+    # -- item protocol ------------------------------------------------------
+
+    def __setitem__(self, key: Any, value: Any) -> None:
+        if isinstance(value, dict) and not isinstance(value, Attributes):
+            value = Attributes(value)
+        super().__setitem__(key, value)
+
+    # update/setdefault/|= bypass __setitem__ in CPython — route them through
+    # it so nested-dict promotion holds on every write path.
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        for key, value in dict(*args, **kwargs).items():
+            self[key] = value
+
+    def setdefault(self, key: Any, default: Any = None) -> Any:
+        if key not in self:
+            self[key] = default
+        return self[key]
+
+    def __ior__(self, other: Any) -> "Attributes":
+        self.update(other)
+        return self
+
+    def copy(self) -> "Attributes":
+        return Attributes(self)
+
+    def __repr__(self) -> str:  # compact, stable for tree dumps
+        body = ", ".join(f"{k}={v!r}" for k, v in self.items())
+        return f"Attributes({body})"
+
+
+def _flatten_with_keys(attrs: Attributes):
+    try:
+        keys = sorted(attrs)
+    except TypeError:  # mixed-type keys — fall back to insertion order
+        keys = list(attrs)
+    children = [(jax.tree_util.DictKey(k), attrs[k]) for k in keys]
+    return children, tuple(keys)
+
+
+def _unflatten(keys, children) -> Attributes:
+    return Attributes(zip(keys, children))
+
+
+# Registered as a pytree node (sorted keys, mirroring dict flattening) so
+# Attributes-valued batches work with jax.tree_util / device_put / jit.
+jax.tree_util.register_pytree_with_keys(
+    Attributes, _flatten_with_keys, _unflatten
+)

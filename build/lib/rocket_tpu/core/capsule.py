@@ -1,0 +1,174 @@
+"""Capsule — the base lifecycle component.
+
+A capsule is a unit of pipeline behavior driven by lifecycle events
+(:class:`~rocket_tpu.core.events.Events`).  Capsules never call each other;
+they read/write the :class:`~rocket_tpu.core.attributes.Attributes` blackboard
+and are ordered inside a :class:`~rocket_tpu.core.dispatcher.Dispatcher` by
+integer ``priority`` (higher runs first).
+
+Capability parity: reference ``rocket/core/capsule.py:71-440``.  Differences
+by design (TPU-first):
+
+- Instead of an ``Accelerator``, every capsule is bound to a
+  :class:`rocket_tpu.runtime.Runtime` (mesh + process topology + checkpoint /
+  tracker registries) via :meth:`bind` — the analogue of reference
+  ``Capsule.accelerate`` (``capsule.py:256-273``).
+- ``state_dict``/``load_state_dict`` exchange **pytrees** (plain dicts of
+  arrays/scalars), so capsule state participates directly in Orbax
+  checkpoints instead of accelerate's pickled ``_custom_objects``
+  (``capsule.py:331-416``).
+- Statefulness is opt-in via ``statefull=True`` (reference spelling kept for
+  user familiarity, ``capsule.py:104-113``); stateful capsules register with
+  the runtime checkpoint registry in :meth:`setup` (``capsule.py:135-139``)
+  and deregister LIFO in :meth:`destroy` (``capsule.py:165-174``) — the
+  Dispatcher's reverse-order destroy upholds the LIFO invariant.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.events import Events
+from rocket_tpu.utils.logging import RankAwareLogger, get_logger
+
+
+class Capsule:
+    """Base lifecycle component.
+
+    Parameters
+    ----------
+    statefull:
+        If ``True``, the capsule's :meth:`state_dict` is included in
+        checkpoints (registered with the runtime at setup).
+    priority:
+        Dispatch order inside a Dispatcher; higher value runs earlier.
+        Default 1000.
+    logger:
+        Optional custom logger; defaults to a rank-aware logger named after
+        the concrete class.
+    """
+
+    def __init__(
+        self,
+        statefull: bool = False,
+        priority: int = 1000,
+        logger: Optional[RankAwareLogger] = None,
+    ) -> None:
+        self._runtime = None
+        self._statefull = statefull
+        self._priority = priority
+        self._logger = logger or get_logger(type(self).__name__)
+        self._registered = False
+        self._ckpt_key: Optional[str] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def setup(self, attrs: Optional[Attributes] = None) -> None:
+        """One-time initialization. Registers stateful capsules for
+        checkpointing (reference ``capsule.py:116-141``)."""
+        self.check_runtime()
+        if self._statefull and not self._registered:
+            # Idempotent: the same capsule mounted in two pipeline branches
+            # (train + eval looper) is set up twice but registers once —
+            # the analogue of the reference's dedupe scans
+            # (``module.py:87-99``, ``dataset.py:158-171``).
+            self._ckpt_key = self._runtime.register_for_checkpointing(self)
+            self._registered = True
+        self._logger.debug("%s.setup done", type(self).__name__)
+
+    def destroy(self, attrs: Optional[Attributes] = None) -> None:
+        """One-time teardown. Deregisters from the checkpoint registry
+        (reference pops LIFO, ``capsule.py:165-174``; here removal is by
+        identity — see ``Runtime.deregister_checkpointable``)."""
+        if self._statefull and self._registered:
+            self.check_runtime()
+            self._runtime.deregister_checkpointable(self)
+            self._registered = False
+        self.clear()
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        """Per-iteration work event (reference ``capsule.py:178-195``)."""
+
+    def set(self, attrs: Optional[Attributes] = None) -> None:
+        """Cycle-start event (reference ``capsule.py:197-214``)."""
+
+    def reset(self, attrs: Optional[Attributes] = None) -> None:
+        """Cycle-end event (reference ``capsule.py:216-233``)."""
+
+    # -- dispatch -----------------------------------------------------------
+
+    def dispatch(self, event: Events, attrs: Optional[Attributes] = None) -> None:
+        """Route an event to the matching handler
+        (reference ``capsule.py:235-254``)."""
+        handler = getattr(self, Events(event).value, None)
+        if handler is None:
+            raise ValueError(f"{type(self).__name__}: unknown event {event!r}")
+        handler(attrs)
+
+    # -- runtime binding ----------------------------------------------------
+
+    def bind(self, runtime: Any) -> None:
+        """Inject the runtime (mesh/topology/registries) top-down.
+
+        Analogue of reference ``Capsule.accelerate`` (``capsule.py:256-273``).
+        Re-binding with a different runtime replaces the old one.
+        """
+        self._runtime = runtime
+
+    def clear(self) -> None:
+        """Drop the runtime binding (reference ``capsule.py:275-306``)."""
+        self._runtime = None
+
+    def check_runtime(self) -> None:
+        """Raise unless a runtime has been bound
+        (reference ``capsule.py:308-329``)."""
+        if self._runtime is None:
+            raise RuntimeError(
+                f"{type(self).__name__} has no runtime bound. Capsules must "
+                f"be part of a Launcher tree (which binds the runtime during "
+                f"setup), or call .bind(runtime) explicitly."
+            )
+
+    @property
+    def runtime(self) -> Any:
+        return self._runtime
+
+    @property
+    def priority(self) -> int:
+        return self._priority
+
+    @property
+    def statefull(self) -> bool:
+        return self._statefull
+
+    # -- state --------------------------------------------------------------
+
+    def state_dict(self) -> Attributes:
+        """Pytree of checkpointable state (reference ``capsule.py:331-375``)."""
+        return Attributes()
+
+    def load_state_dict(self, state: Attributes) -> None:
+        """Restore from :meth:`state_dict` output
+        (reference ``capsule.py:377-416``)."""
+        if state:
+            raise RuntimeError(
+                f"{type(self).__name__}.load_state_dict got non-empty state "
+                f"but defines none."
+            )
+
+    # -- introspection ------------------------------------------------------
+
+    def __repr__(self) -> str:
+        """Config dump: class name + non-private scalar config
+        (reference ``capsule.py:419-440``)."""
+        hidden = {"_runtime", "_logger", "_registered", "_capsules"}
+        fields = []
+        for key, value in vars(self).items():
+            if key in hidden:
+                continue
+            text = repr(value)
+            if len(text) > 120:
+                text = f"<{type(value).__name__}>"
+            fields.append(f"{key.lstrip('_')}={text}")
+        return f"{type(self).__name__}({', '.join(fields)})"

@@ -1,0 +1,30 @@
+"""Lifecycle event vocabulary for capsules.
+
+Capability parity: reference ``rocket/core/capsule.py:38-68``.
+
+The five events partition a run:
+
+- ``SETUP``   — once, before anything else: allocate resources, build jitted
+  steps, register stateful components with the runtime checkpoint registry.
+- ``SET``     — start of every cycle (epoch / eval pass): reset iterators,
+  open tracker buffers, publish per-cycle protocol keys on the blackboard.
+- ``LAUNCH``  — the work event, fired once per iteration (or once per cycle
+  for composite loop owners).
+- ``RESET``   — end of every cycle: flush buffers, drop per-cycle keys.
+- ``DESTROY`` — once, after the run: release resources in reverse order.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Events(str, enum.Enum):
+    SETUP = "setup"
+    SET = "set"
+    LAUNCH = "launch"
+    RESET = "reset"
+    DESTROY = "destroy"
+
+    def __str__(self) -> str:  # pragma: no cover - cosmetic
+        return self.value

@@ -1,0 +1,378 @@
+"""Module — the compute capsule: model + losses + optimizer + scheduler.
+
+Capability parity: reference ``rocket/core/module.py:25-219`` — a Dispatcher
+wrapping the model whose children are ``Loss``/``Optimizer``/``Scheduler``
+capsules, running forward (+ children) once per iteration with AMP and
+gradient accumulation (``module.py:110-142,175-219``).
+
+TPU-first redesign (SURVEY §7.4 "hard parts"): the reference executes
+forward → backward → step as separate Python-driven phases every iteration;
+here Module **compiles them into one jitted, donated train step** at setup
+time.  The child capsules are split into two roles:
+
+- *in-step* (traced, pure): each ``Loss`` child contributes its pure
+  objective fn; the ``Optimizer`` child contributes the optax transform; the
+  ``Scheduler`` child contributes the LR schedule.  These are collected once
+  and baked into ``engine.step.build_train_step``.
+- *out-of-step* (host, evented): the same children still receive LAUNCH each
+  iteration — but now only for their host-side duties (tracker records, loop
+  status, counters), reading the step's log dict from ``attrs.step_logs``.
+
+State is an explicit :class:`~rocket_tpu.engine.state.TrainState` pytree
+owned by this capsule — the functional replacement for accelerate's
+``_models``/``_optimizers`` registries.  It materializes lazily on the first
+batch (or eagerly from ``input_spec``), jit-initialized with
+``out_shardings`` so parameters are *born sharded* across the mesh.
+
+Blackboard protocol:
+
+- reads  ``attrs.batch`` (global device arrays), ``attrs.looper.grad_enabled``
+- train: ``attrs.step_logs`` = per-step scalars (device) + ``synced`` flag
+- eval:  rewrites ``attrs.batch`` with model outputs (reference
+  ``module.py:139``) for downstream ``Meter`` capsules
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.core.dispatcher import Dispatcher
+from rocket_tpu.engine.adapter import FlaxModel, ModelAdapter, state_shardings
+from rocket_tpu.engine.state import TrainState, param_count
+from rocket_tpu.engine.step import build_eval_step, build_train_step
+from rocket_tpu.parallel.sharding import tree_shardings
+
+
+def _as_adapter(model: Any) -> ModelAdapter:
+    if isinstance(model, ModelAdapter):
+        return model
+    try:
+        import flax.linen as nn
+
+        if isinstance(model, nn.Module):
+            return FlaxModel(model)
+    except ImportError:  # pragma: no cover
+        pass
+    raise TypeError(
+        f"Module expects a ModelAdapter or flax.linen.Module, got "
+        f"{type(model).__name__}"
+    )
+
+
+class Module(Dispatcher):
+    """Compute capsule (reference ``rocket/core/module.py``).
+
+    Parameters
+    ----------
+    model:
+        A :class:`~rocket_tpu.engine.adapter.ModelAdapter` or a
+        ``flax.linen.Module`` whose ``__call__(batch, train)`` rewrites the
+        batch (auto-wrapped in :class:`FlaxModel`).
+    capsules:
+        Child capsules — ``Loss`` / ``Optimizer`` / ``Scheduler`` (reference
+        ``module.py:53-55``).
+    input_spec:
+        Optional abstract batch (pytree of ``jax.ShapeDtypeStruct``) for
+        eager state materialization at setup; default is lazy
+        materialization on the first batch.
+    """
+
+    # Array state restores at materialization (sharded, direct to mesh) —
+    # the Launcher's host-state resume pass skips this capsule.
+    lazy_state = True
+
+    def __init__(
+        self,
+        model: Any,
+        capsules: Iterable[Capsule] = (),
+        input_spec: Optional[Any] = None,
+        statefull: bool = True,
+        priority: int = 1000,
+        donate: bool = True,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(
+            capsules=capsules, statefull=statefull, priority=priority, logger=logger
+        )
+        self._adapter = _as_adapter(model)
+        self._input_spec = input_spec
+        self._donate = donate
+        self._built = False
+        self._state: Optional[TrainState] = None
+        self._steps: Optional[dict] = None
+        self._eval_step = None
+        self._tx = None
+        self._schedule = None
+        self._micro_idx = 0
+        self._accum = 1
+        self._pending_restore: Optional[Any] = None
+
+    # -- setup / teardown ---------------------------------------------------
+
+    def setup(self, attrs: Optional[Attributes] = None) -> None:
+        if self._built:
+            return  # dedupe: mounted in a second (eval) looper branch
+        super().setup(attrs)
+        if not self._runtime.register_unique("model", self._adapter):
+            raise RuntimeError(
+                "the same model adapter is wrapped by two Module capsules — "
+                "share one Module instance across loopers instead "
+                "(reference dedupe contract, module.py:92-96)."
+            )
+        self._collect_components()
+        self._accum = self._runtime.gradient_accumulation_steps
+        if self._runtime.resume_spec is not None and self.statefull:
+            self._pending_restore = self._runtime.resume_spec
+        if self._input_spec is not None:
+            self.materialize(self._input_spec)
+        self._built = True
+
+    def destroy(self, attrs: Optional[Attributes] = None) -> None:
+        if not self._built:
+            return
+        if self._runtime is not None:
+            self._runtime.deregister_unique("model", self._adapter)
+        # Keep self._state: the trained params outlive the run, the way the
+        # reference's torch module keeps its weights after launch.
+        self._steps = None
+        self._eval_step = None
+        self._built = False
+        super().destroy(attrs)
+
+    def _collect_components(self) -> None:
+        from rocket_tpu.core.loss import Loss
+        from rocket_tpu.core.optimizer import Optimizer
+        from rocket_tpu.core.scheduler import Scheduler
+
+        self._objectives = [
+            c.objective for c in self._capsules if isinstance(c, Loss)
+        ]
+        optimizers = [c for c in self._capsules if isinstance(c, Optimizer)]
+        schedulers = [c for c in self._capsules if isinstance(c, Scheduler)]
+        if len(optimizers) > 1 or len(schedulers) > 1:
+            raise RuntimeError(
+                "a Module hosts at most one Optimizer and one Scheduler"
+            )
+        self._schedule = schedulers[0].schedule if schedulers else None
+        if optimizers:
+            self._tx = optimizers[0].build_tx(self._schedule)
+            optimizers[0].attach_schedule(
+                self._schedule
+                if self._schedule is not None
+                else optimizers[0].constant_schedule()
+            )
+        if self._tx is not None and not self._objectives:
+            raise RuntimeError(
+                "Module has an Optimizer but no Loss — nothing to minimize"
+            )
+
+    # -- state materialization ---------------------------------------------
+
+    def materialize(self, batch: Any) -> None:
+        """Build (or restore) the TrainState + jitted steps for this batch
+        structure.  ``batch`` may be concrete arrays or ShapeDtypeStructs."""
+        runtime = self._runtime
+        self.check_runtime()
+        mesh = runtime.mesh
+        policy = runtime.policy
+        rng = jax.random.PRNGKey(runtime.seed)
+        configure = getattr(self._adapter, "configure", None)
+        if configure is not None:
+            configure(mesh, runtime.rules)
+        apply_policy = getattr(self._adapter, "apply_policy", None)
+        if apply_policy is not None:
+            apply_policy(policy)
+
+        abstract_batch = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(jnp.shape(x), jnp.result_type(x)), batch
+        )
+
+        def init_fn() -> TrainState:
+            params, mutable = self._adapter.init_variables(rng, abstract_batch_concrete())
+            params = policy.cast_to_param(params)
+            tx = self._tx if self._tx is not None else _null_tx()
+            return TrainState.create(
+                params,
+                tx,
+                rng=rng,
+                mutable=mutable,
+                gradient_accumulation_steps=self._accum,
+            )
+
+        def abstract_batch_concrete() -> Any:
+            # Inside jit/eval_shape we need traceable zeros, not structs.
+            return jax.tree_util.tree_map(
+                lambda s: jnp.zeros(s.shape, s.dtype), abstract_batch
+            )
+
+        abstract_state = jax.eval_shape(init_fn)
+        param_specs = self._adapter.partition_specs(
+            abstract_state.params, runtime.rules
+        )
+        shardings = state_shardings(mesh, abstract_state, param_specs)
+
+        self._weights_override = None
+        if self._pending_restore is not None:
+            self._restore_state(abstract_state, shardings)
+        if self._state is None:
+            with jax.transfer_guard("allow"):
+                self._state = jax.jit(init_fn, out_shardings=shardings)()
+            if self._weights_override is not None:
+                params, mutable = self._weights_override
+                self._weights_override = None
+                replacements = {"params": params}
+                if mutable is not None:
+                    replacements["mutable"] = mutable
+                self._state = self._state.replace(**replacements)
+            self._logger.info(
+                "materialized %s params (%d leaves) on mesh %s",
+                f"{param_count(self._state.params):,}",
+                len(jax.tree_util.tree_leaves(self._state.params)),
+                dict(mesh.shape),
+            )
+        self._shardings = shardings
+        self._build_steps(policy)
+
+    def _build_steps(self, policy) -> None:
+        if self._tx is not None:
+            self._steps = build_train_step(
+                self._adapter.apply_fn,
+                self._objectives,
+                self._tx,
+                policy=policy,
+                gradient_accumulation_steps=self._accum,
+                donate=self._donate,
+            )
+        self._eval_step = build_eval_step(
+            self._adapter.apply_fn, self._objectives, policy=policy
+        )
+
+    def _restore_state(self, abstract_state: TrainState, shardings: Any) -> None:
+        from rocket_tpu.persist.orbax_io import default_io
+
+        spec = self._pending_restore
+        self._pending_restore = None
+        if spec.load_capsules:
+            # Full resume: whole TrainState (params, optimizer moments, step,
+            # rng), restored sharded, direct to mesh layout.
+            target = jax.tree_util.tree_map(
+                lambda leaf, s: jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype, sharding=s
+                ),
+                abstract_state,
+                shardings,
+            )
+            restored = default_io().restore_item(
+                str(spec.path), self._ckpt_key, target={"state": target}
+            )
+            self._state = restored["state"]
+            self._sync_micro_idx()
+            self._logger.info("restored full module state from %s", spec.path)
+            return
+        # Weights-only (reference ``launcher.py:349-359``): restore params +
+        # mutable collections; optimizer state, step and rng start fresh —
+        # the fine-tune-from-weights contract.  A partial target keeps the
+        # restore sharded and tolerates a checkpoint whose optimizer
+        # structure differs from this run's.
+        partial = {"params": (abstract_state.params, shardings.params)}
+        if jax.tree_util.tree_leaves(abstract_state.mutable):
+            partial["mutable"] = (abstract_state.mutable, shardings.mutable)
+        target = {
+            field: jax.tree_util.tree_map(
+                lambda leaf, s: jax.ShapeDtypeStruct(
+                    leaf.shape, leaf.dtype, sharding=s
+                ),
+                abstract,
+                shard,
+            )
+            for field, (abstract, shard) in partial.items()
+        }
+        restored = default_io().restore_item(
+            str(spec.path), self._ckpt_key, target={"state": target}, partial=True
+        )["state"]
+        self._weights_override = (restored["params"], restored.get("mutable"))
+        self._logger.info("restored weights only from %s", spec.path)
+
+    # -- iteration ----------------------------------------------------------
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        attrs = attrs if attrs is not None else Attributes()
+        batch = attrs.batch
+        if batch is None:
+            return  # upstream Dataset exhausted / skipped
+        if self._state is None or self._eval_step is None:
+            # No eval step ⇒ steps were never built for this state (e.g. the
+            # state arrived via load_state_dict); materialize keeps an
+            # existing state and (re)builds the jitted steps.
+            self.materialize(batch)
+
+        looper = attrs.looper
+        grad_enabled = True if looper is None else bool(looper.grad_enabled)
+
+        if grad_enabled and self._steps is not None:
+            synced = (self._micro_idx + 1) % self._accum == 0
+            step = self._steps["sync" if synced else "micro"]
+            self._state, logs = step(self._state, batch)
+            self._micro_idx = 0 if synced else self._micro_idx + 1
+            logs = Attributes(logs)
+            logs.synced = synced
+            attrs.step_logs = logs
+        else:
+            batch_out, logs = self._eval_step(self._state, batch)
+            attrs.batch = batch_out
+            logs = Attributes(logs)
+            logs.synced = False
+            attrs.step_logs = logs
+
+        # Children (Loss/Optimizer/Scheduler) do host-side logging only.
+        for capsule in self._capsules:
+            capsule.launch(attrs)
+
+    # -- state --------------------------------------------------------------
+
+    @property
+    def state(self) -> Optional[TrainState]:
+        return self._state
+
+    @state.setter
+    def state(self, value: TrainState) -> None:
+        self._state = value
+
+    @property
+    def step(self) -> int:
+        if self._state is None:
+            return 0
+        return int(self._state.step)
+
+    def state_dict(self) -> Attributes:
+        if self._state is None:
+            return Attributes()
+        return Attributes(state=self._state)
+
+    def load_state_dict(self, state: Attributes) -> None:
+        # Array state restores through _restore_state (needs shardings); a
+        # direct host-side pytree (single-host tests) is also accepted.
+        if state and "state" in state:
+            self._state = state["state"]
+            self._sync_micro_idx()
+
+    def _sync_micro_idx(self) -> None:
+        """Re-derive the host-side accumulation-window position from the
+        restored TrainState so a resume that lands mid-window re-enters the
+        window where it left off (``state.micro`` is the saved counterpart
+        of ``_micro_idx``: +1 per micro step, reset to 0 at each sync)."""
+        if self._state is not None and self._state.micro is not None:
+            self._micro_idx = int(self._state.micro) % self._accum
+        else:
+            self._micro_idx = 0
+
+
+def _null_tx():
+    import optax
+
+    return optax.identity()

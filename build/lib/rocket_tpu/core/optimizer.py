@@ -1,0 +1,130 @@
+"""Optimizer — optax-backed parameter updates.
+
+Capability parity: reference ``rocket/core/optimizer.py:20-204`` — wraps the
+user's optimizer, steps it each iteration (no-op inside the accumulation
+window), and logs the learning rate per effective step
+(``optimizer.py:127-147``).
+
+TPU-first split: ``step()``/``zero_grad()`` have no host-side existence —
+the optax update is traced into the jitted train step by the parent
+:class:`~rocket_tpu.core.module.Module` (``build_tx`` is called at Module
+setup; a sibling ``Scheduler``'s schedule becomes the learning rate).  The
+capsule's runtime duties are the reference's host-side ones: LR logging on
+synced steps and the effective-step counter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import optax
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+
+
+class Optimizer(Capsule):
+    """Parameters
+    ----------
+    tx:
+        A ready ``optax.GradientTransformation``. Mutually exclusive with
+        ``tx_factory``/``learning_rate`` (and incompatible with a sibling
+        Scheduler, which needs to inject its schedule).
+    tx_factory:
+        Callable ``(learning_rate, **kwargs) -> GradientTransformation``
+        (default ``optax.adamw``).
+    learning_rate:
+        Base LR; ignored when a sibling ``Scheduler`` provides a schedule.
+    grad_clip_norm:
+        Optional global-norm clipping chained before the update.
+    """
+
+    def __init__(
+        self,
+        tx: Optional[optax.GradientTransformation] = None,
+        tx_factory: Callable[..., optax.GradientTransformation] = optax.adamw,
+        learning_rate: float = 1e-3,
+        grad_clip_norm: Optional[float] = None,
+        wrap: Optional[Callable[[optax.GradientTransformation], optax.GradientTransformation]] = None,
+        tag: str = "lr",
+        statefull: bool = True,
+        priority: int = 1000,
+        logger: Optional[Any] = None,
+        **tx_kwargs: Any,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, logger=logger)
+        self._tx = tx
+        self._tx_factory = tx_factory
+        self._learning_rate = learning_rate
+        self._grad_clip_norm = grad_clip_norm
+        self._wrap = wrap
+        self._tx_kwargs = tx_kwargs
+        self._tag = tag
+        self._iter_idx = 0
+        self._log_schedule: Optional[Callable[[int], Any]] = None
+
+    # -- step construction (called by parent Module at setup) ----------------
+
+    def build_tx(
+        self, schedule: Optional[optax.Schedule] = None
+    ) -> optax.GradientTransformation:
+        if self._tx is not None:
+            if schedule is not None:
+                raise RuntimeError(
+                    "Optimizer was given a ready optax transform; a sibling "
+                    "Scheduler cannot inject its schedule. Pass tx_factory "
+                    "instead."
+                )
+            tx = self._tx
+        else:
+            lr = schedule if schedule is not None else self._learning_rate
+            tx = self._tx_factory(lr, **self._tx_kwargs)
+        if self._grad_clip_norm is not None:
+            tx = optax.chain(optax.clip_by_global_norm(self._grad_clip_norm), tx)
+        if self._wrap is not None:
+            # e.g. models.lora.freeze_non_lora — base weights frozen,
+            # adapters train (the LoRA fine-tune contract).
+            tx = self._wrap(tx)
+        return tx
+
+    def constant_schedule(self) -> Callable[[int], Any]:
+        lr = self._learning_rate
+        return lambda step: lr
+
+    def attach_schedule(self, schedule: Callable[[int], Any]) -> None:
+        self._log_schedule = schedule
+
+    # -- events -------------------------------------------------------------
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        """LR logging on effective steps (reference ``optimizer.py:133-147``).
+        The update itself ran inside the jitted step."""
+        if attrs is None or attrs.step_logs is None:
+            return
+        looper = attrs.looper
+        if looper is not None and not looper.grad_enabled:
+            return
+        if not attrs.step_logs.synced:
+            return
+        if self._log_schedule is not None:
+            lr = self._log_schedule(self._iter_idx)
+            if attrs.tracker is not None:
+                attrs.tracker.scalars.append(
+                    Attributes(step=self._iter_idx, data={self._tag: lr})
+                )
+            if looper is not None:
+                state = looper.state
+                if state is None:
+                    state = looper.state = Attributes()
+                state[self._tag] = lr
+        self._iter_idx += 1
+
+    # -- state --------------------------------------------------------------
+
+    def state_dict(self) -> Attributes:
+        return Attributes(iter_idx=self._iter_idx)
+
+    def load_state_dict(self, state: Attributes) -> None:
+        if not state:
+            return
+        self._iter_idx = int(state["iter_idx"])

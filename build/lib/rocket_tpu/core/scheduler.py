@@ -1,0 +1,51 @@
+"""Scheduler — learning-rate schedule.
+
+Capability parity: reference ``rocket/core/scheduler.py:20-143`` — wraps the
+user's LR scheduler and steps it once per iteration when grads are enabled
+(``scheduler.py:112-113``).
+
+TPU-first split: optax schedules are pure functions of the step counter, so
+there is nothing to "step" at runtime — the parent
+:class:`~rocket_tpu.core.module.Module` passes this capsule's ``schedule``
+into the sibling ``Optimizer``'s ``build_tx`` (the schedule becomes the
+optax learning rate, evaluated at ``state.step`` inside the jitted update).
+The capsule exists for tree-shape parity, config introspection, and to own
+the schedule definition in the pipeline description.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+
+
+class Scheduler(Capsule):
+    """Parameters
+    ----------
+    schedule:
+        An ``optax.Schedule`` — any ``step -> learning_rate`` callable (e.g.
+        ``optax.cosine_decay_schedule(...)``, ``optax.warmup_cosine_decay_
+        schedule(...)``).
+    """
+
+    def __init__(
+        self,
+        schedule: Callable[[int], Any],
+        statefull: bool = False,
+        priority: int = 1000,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, logger=logger)
+        if not callable(schedule):
+            raise TypeError("Scheduler expects an optax schedule (callable)")
+        self._schedule = schedule
+
+    @property
+    def schedule(self) -> Callable[[int], Any]:
+        return self._schedule
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        """No runtime work: the schedule is evaluated inside the jitted step
+        (reference stepped eagerly at ``scheduler.py:112-113``)."""

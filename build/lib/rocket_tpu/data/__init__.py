@@ -1,0 +1,12 @@
+from rocket_tpu.data.dataset import Dataset
+from rocket_tpu.data.loader import DataLoader
+from rocket_tpu.data.source import ArraySource, ConcatSource, MapSource, Source
+
+__all__ = [
+    "ArraySource",
+    "ConcatSource",
+    "DataLoader",
+    "Dataset",
+    "MapSource",
+    "Source",
+]

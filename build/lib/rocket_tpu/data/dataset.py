@@ -1,0 +1,142 @@
+"""Dataset — the data-feeding capsule.
+
+Capability parity: reference ``rocket/core/dataset.py:23-361``:
+
+- builds the loader at setup with dedupe against the runtime registry
+  (``dataset.py:158-180``);
+- ``set`` prepares the epoch iterator, resuming mid-epoch when
+  ``_batch_idx > 0`` (``dataset.py:205-213``);
+- ``launch`` skips when ``attrs.batch`` is occupied (``:264``), pulls the
+  next batch, votes termination through ``attrs.looper.terminate``
+  (``:274-276``), else publishes the device batch and counts it
+  (``:279-288``);
+- ``state_dict`` persists ``batch_idx`` for deterministic resume (``:328``).
+
+TPU-first: "move to device" is global-array assembly over the mesh's data
+axes (H2D prefetched under compute), not a per-rank ``.to(device)`` —
+see :mod:`rocket_tpu.data.loader`.  The reference's ``destroy`` bug (clears
+the loader ref before deregistering it, ``dataset.py:313-326``, SURVEY §2.4)
+is fixed here: deregister first, then drop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.data.loader import DataLoader
+
+
+class Dataset(Capsule):
+    """Parameters mirror :class:`~rocket_tpu.data.loader.DataLoader`; a
+    ready loader can also be passed directly (``Dataset(loader=...)``)."""
+
+    def __init__(
+        self,
+        source: Any = None,
+        batch_size: int = 1,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        prefetch: int = 2,
+        loader: Optional[DataLoader] = None,
+        statefull: bool = True,
+        priority: int = 1000,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, logger=logger)
+        if (source is None) == (loader is None):
+            raise ValueError("pass exactly one of source= or loader=")
+        self._source = source
+        self._loader = loader
+        self._loader_kwargs = dict(
+            batch_size=batch_size,
+            shuffle=shuffle,
+            seed=seed,
+            drop_last=drop_last,
+            collate_fn=collate_fn,
+            prefetch=prefetch,
+        )
+        self._iterator = None
+        self._total: Optional[int] = None
+        self._batch_idx = 0
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def setup(self, attrs: Optional[Attributes] = None) -> None:
+        super().setup(attrs)
+        if self._loader is None:
+            self._loader = DataLoader(
+                self._source,
+                sharding=self._runtime.batch_sharding(ndim=1),
+                **self._loader_kwargs,
+            )
+        elif self._loader.sharding is None:
+            self._loader.sharding = self._runtime.batch_sharding(ndim=1)
+        self._runtime.register_unique("dataset", self._loader)
+        self._total = len(self._loader)
+
+    def destroy(self, attrs: Optional[Attributes] = None) -> None:
+        # Deregister BEFORE dropping the reference (fixes reference bug,
+        # ``dataset.py:313-326``).
+        if self._runtime is not None and self._loader is not None:
+            self._runtime.deregister_unique("dataset", self._loader)
+        self._iterator = None
+        if self._source is not None:
+            self._loader = None
+        super().destroy(attrs)
+
+    # -- cycle --------------------------------------------------------------
+
+    def set(self, attrs: Optional[Attributes] = None) -> None:
+        """Open the epoch iterator; fast-forward on mid-epoch resume
+        (reference ``dataset.py:182-213``)."""
+        epoch = 0
+        if attrs is not None and attrs.launcher is not None:
+            epoch = int(attrs.launcher.epoch_idx or 0)
+        skip = self._batch_idx
+        if skip:
+            self._logger.info(
+                "resuming mid-epoch: skipping %d already-seen batches", skip
+            )
+        self._iterator = self._loader.iterate(epoch=epoch, skip_batches=skip)
+
+    def reset(self, attrs: Optional[Attributes] = None) -> None:
+        """Close the cycle (reference ``dataset.py:215-238``)."""
+        self._iterator = None
+        self._batch_idx = 0
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        if attrs is None:
+            return
+        if attrs.batch is not None:
+            return  # another Dataset already fed this iteration (``:264``)
+        if self._iterator is None:
+            self.set(attrs)
+        data = next(self._iterator, None)
+        if data is None:
+            if attrs.looper is not None:
+                attrs.looper.terminate = True  # empty -> vote to stop (``:274``)
+            return
+        attrs.batch = data
+        if attrs.looper is not None:
+            attrs.looper.terminate = False
+        self._batch_idx += 1
+
+    # -- introspection / state ----------------------------------------------
+
+    @property
+    def total(self) -> Optional[int]:
+        """Batches per epoch (used by Looper repeats inference,
+        reference ``loop.py:312-319``)."""
+        return self._total
+
+    def state_dict(self) -> Attributes:
+        return Attributes(batch_idx=self._batch_idx)
+
+    def load_state_dict(self, state: Attributes) -> None:
+        if not state:
+            return
+        self._batch_idx = int(state["batch_idx"])

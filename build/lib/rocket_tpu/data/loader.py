@@ -1,0 +1,207 @@
+"""DataLoader — deterministic, sharded, device-prefetching batch pipeline.
+
+Replaces the reference's ``torch.utils.data.DataLoader`` +
+``accelerator.prepare(dataloader)`` pair (``rocket/core/dataset.py:100-180``)
+with a TPU-first design:
+
+- **Static shapes**: every batch has the same global shape.  The last partial
+  batch is padded by wrap-around and marked in a ``_valid`` boolean mask
+  instead of being shape-shifted — a shape change would force an XLA
+  recompile of the whole train step.  The mask is the explicit form of
+  accelerate's ``gather_for_metrics`` duplicate-dedup (``meter.py:93``,
+  SURVEY §7.4).
+- **Per-host sharding**: each process materializes only its slice of the
+  global batch; :func:`jax.make_array_from_process_local_data` assembles the
+  logical global array laid out over the mesh's data axes (replaces
+  accelerate's per-rank dataloader sharding, ``dataset.py:175-180``).
+- **Deterministic order + mid-epoch resume**: the epoch permutation is a pure
+  function of ``(seed, epoch)``; resuming at batch *k* replays the
+  permutation and skips — the equivalent of ``skip_first_batches``
+  (``dataset.py:205-210``) without touching data state.
+- **Prefetch double-buffering**: a background thread stages collated host
+  batches; device transfer is issued ahead so H2D rides under compute
+  (replaces torch pin-memory workers, SURVEY §2.1).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Iterator, Optional
+
+import jax
+import numpy as np
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.utils.placement import collate as default_collate
+
+
+class DataLoader:
+    """Parameters
+    ----------
+    source:
+        Map-style source (``__len__`` + ``__getitem__``).
+    batch_size:
+        **Global** batch size (across all hosts/devices).
+    shuffle / seed:
+        Seeded epoch permutation; order is reproducible across restarts.
+    drop_last:
+        Drop the trailing partial batch instead of pad+mask.
+    collate_fn:
+        Sample-list -> batch pytree (default stacks arrays, passes the rest
+        through as lists — reference ``torch_collate`` semantics).
+    sharding:
+        ``jax.sharding.NamedSharding`` for the batch's leading dim (from
+        ``runtime.batch_sharding()``). ``None`` keeps batches on host.
+    prefetch:
+        Number of batches staged ahead (0 disables the background thread).
+    """
+
+    def __init__(
+        self,
+        source: Any,
+        batch_size: int,
+        shuffle: bool = False,
+        seed: int = 0,
+        drop_last: bool = False,
+        collate_fn: Optional[Callable] = None,
+        sharding: Optional[Any] = None,
+        prefetch: int = 2,
+        mask_key: str = "_valid",
+    ) -> None:
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        self.source = source
+        self.batch_size = int(batch_size)
+        self.shuffle = shuffle
+        self.seed = int(seed)
+        self.drop_last = drop_last
+        self.collate_fn = collate_fn or default_collate
+        self.sharding = sharding
+        self.prefetch = int(prefetch)
+        self.mask_key = mask_key
+        self.epoch = 0
+
+        procs = jax.process_count()
+        if self.batch_size % procs != 0:
+            raise ValueError(
+                f"global batch_size {batch_size} must divide evenly over "
+                f"{procs} processes"
+            )
+        self.local_batch_size = self.batch_size // procs
+
+    # -- length -------------------------------------------------------------
+
+    def __len__(self) -> int:
+        n = len(self.source)
+        if self.drop_last:
+            return n // self.batch_size
+        return (n + self.batch_size - 1) // self.batch_size
+
+    # -- index plan ---------------------------------------------------------
+
+    def _epoch_order(self, epoch: int) -> np.ndarray:
+        n = len(self.source)
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, epoch))
+            return rng.permutation(n)
+        return np.arange(n)
+
+    def _batch_indices(self, epoch: int) -> Iterator[tuple]:
+        """Yield ``(global_indices, valid_mask)`` per batch, already padded
+        to the static global batch size."""
+        order = self._epoch_order(epoch)
+        n = len(order)
+        num_batches = len(self)
+        for b in range(num_batches):
+            lo = b * self.batch_size
+            hi = lo + self.batch_size
+            idx = order[lo:hi]
+            valid = np.ones(len(idx), dtype=bool)
+            if len(idx) < self.batch_size:  # wrap-around pad + mask
+                pad = self.batch_size - len(idx)
+                idx = np.concatenate([idx, order[:pad]])
+                valid = np.concatenate([valid, np.zeros(pad, dtype=bool)])
+            yield idx, valid
+
+    # -- batch materialization ---------------------------------------------
+
+    def _host_batch(self, idx: np.ndarray, valid: np.ndarray) -> Any:
+        """Collate THIS process's slice of the global batch."""
+        p = jax.process_index()
+        lo = p * self.local_batch_size
+        hi = lo + self.local_batch_size
+        samples = [self.source[int(i)] for i in idx[lo:hi]]
+        batch = self.collate_fn(samples)
+        if not isinstance(batch, (dict, Attributes)):
+            batch = Attributes(data=batch)
+        batch = Attributes(batch)
+        batch[self.mask_key] = valid[lo:hi]
+        return batch
+
+    def _to_device(self, host_batch: Any) -> Any:
+        if self.sharding is None:
+            return host_batch
+
+        def place(leaf: Any) -> Any:
+            leaf = np.asarray(leaf)
+            sh = self.sharding
+            if leaf.ndim < 1:
+                return jax.device_put(leaf)
+            if leaf.ndim != len(sh.spec):
+                # spec was built for a particular rank; re-rank it: leading
+                # dim sharded over data axes, the rest replicated.
+                from rocket_tpu.parallel.sharding import batch_sharding
+
+                sh = batch_sharding(sh.mesh, ndim=leaf.ndim)
+            return jax.make_array_from_process_local_data(sh, leaf)
+
+        return jax.tree_util.tree_map(place, host_batch)
+
+    # -- iteration ----------------------------------------------------------
+
+    def __iter__(self) -> Iterator[Any]:
+        return self.iterate(epoch=self.epoch)
+
+    def iterate(self, epoch: int = 0, skip_batches: int = 0) -> Iterator[Any]:
+        """Iterate one epoch; ``skip_batches`` replays the permutation and
+        fast-forwards (mid-epoch resume, reference ``skip_first_batches``,
+        ``dataset.py:205-210``)."""
+        plan = self._batch_indices(epoch)
+        for _ in range(skip_batches):
+            next(plan, None)
+        if self.prefetch <= 0:
+            for idx, valid in plan:
+                yield self._to_device(self._host_batch(idx, valid))
+            return
+        yield from self._prefetch_iter(plan)
+
+    def _prefetch_iter(self, plan: Iterator[tuple]) -> Iterator[Any]:
+        q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
+        sentinel = object()
+        error: list = []
+
+        def producer() -> None:
+            try:
+                for idx, valid in plan:
+                    q.put(self._host_batch(idx, valid))
+            except BaseException as exc:  # propagate into consumer
+                error.append(exc)
+            finally:
+                q.put(sentinel)
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        staged = None
+        while True:
+            item = q.get()
+            if item is sentinel:
+                if error:
+                    raise error[0]
+                break
+            device_batch = self._to_device(item)
+            if staged is not None:
+                yield staged
+            staged = device_batch
+        if staged is not None:
+            yield staged

@@ -1,0 +1,83 @@
+"""Data sources — the map-style sample store.
+
+The reference consumes any ``torch.utils.data.Dataset`` through a
+``DataLoader`` (``rocket/core/dataset.py:100-126``).  Here a *source* is the
+minimal map-style protocol — ``__len__`` + ``__getitem__ -> pytree of numpy
+leaves`` — so torch datasets, HF ``datasets``, and plain arrays all plug in
+without adapters (torch tensors are converted by the collate hooks in
+:mod:`rocket_tpu.utils.placement`).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence
+
+import numpy as np
+
+
+class Source:
+    """Map-style sample store protocol."""
+
+    def __len__(self) -> int:
+        raise NotImplementedError
+
+    def __getitem__(self, index: int) -> Any:
+        raise NotImplementedError
+
+
+class ArraySource(Source):
+    """Wrap a pytree of equal-leading-dim arrays as a source of per-index
+    pytree samples — the idiomatic in-memory dataset (MNIST-sized data lives
+    happily in host RAM; bigger data should stream via grain/HF datasets)."""
+
+    def __init__(self, data: Any) -> None:
+        import jax
+
+        self._data = data
+        lengths = {
+            int(np.shape(leaf)[0]) for leaf in jax.tree_util.tree_leaves(data)
+        }
+        if len(lengths) != 1:
+            raise ValueError(
+                f"ArraySource leaves disagree on leading dim: {sorted(lengths)}"
+            )
+        self._length = lengths.pop()
+
+    def __len__(self) -> int:
+        return self._length
+
+    def __getitem__(self, index: int) -> Any:
+        import jax
+
+        return jax.tree_util.tree_map(lambda leaf: leaf[index], self._data)
+
+
+class MapSource(Source):
+    """Apply a per-sample transform lazily (augmentation hook)."""
+
+    def __init__(self, source: Any, fn: Callable[[Any], Any]) -> None:
+        self._source = source
+        self._fn = fn
+
+    def __len__(self) -> int:
+        return len(self._source)
+
+    def __getitem__(self, index: int) -> Any:
+        return self._fn(self._source[index])
+
+
+class ConcatSource(Source):
+    """Concatenate sources end-to-end."""
+
+    def __init__(self, sources: Sequence[Any]) -> None:
+        self._sources = list(sources)
+        self._offsets = np.cumsum([0] + [len(s) for s in self._sources])
+
+    def __len__(self) -> int:
+        return int(self._offsets[-1])
+
+    def __getitem__(self, index: int) -> Any:
+        if index < 0:
+            index += len(self)
+        bucket = int(np.searchsorted(self._offsets, index, side="right")) - 1
+        return self._sources[bucket][index - int(self._offsets[bucket])]

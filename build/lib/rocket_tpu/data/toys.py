@@ -1,0 +1,147 @@
+"""Toy datasets — self-contained data for examples, tests and benches.
+
+The environment has no network egress, so the canonical example datasets
+are either loaded from local files (real MNIST IDX files if you have them —
+:func:`load_mnist_idx` parses the standard format with no extra deps) or
+generated procedurally (:func:`synthetic_mnist` draws digit glyphs with
+noise/jitter — linearly inseparable enough that the LeNet pipeline is a real
+test, while converging in a couple of epochs).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+# 7-segment style digit masks on a 7x4 cell grid, upscaled to 28x28.
+_SEGMENTS = {  # (top, top-left, top-right, middle, bottom-left, bottom-right, bottom)
+    0: (1, 1, 1, 0, 1, 1, 1),
+    1: (0, 0, 1, 0, 0, 1, 0),
+    2: (1, 0, 1, 1, 1, 0, 1),
+    3: (1, 0, 1, 1, 0, 1, 1),
+    4: (0, 1, 1, 1, 0, 1, 0),
+    5: (1, 1, 0, 1, 0, 1, 1),
+    6: (1, 1, 0, 1, 1, 1, 1),
+    7: (1, 0, 1, 0, 0, 1, 0),
+    8: (1, 1, 1, 1, 1, 1, 1),
+    9: (1, 1, 1, 1, 0, 1, 1),
+}
+
+
+def _glyph(digit: int) -> np.ndarray:
+    """28x28 float glyph for a digit (7-segment, thick strokes)."""
+    img = np.zeros((28, 28), np.float32)
+    t, tl, tr, m, bl, br, b = _SEGMENTS[digit]
+    x0, x1 = 6, 21
+    y_top, y_mid, y_bot = 4, 13, 22
+    w = 3
+    if t:
+        img[y_top : y_top + w, x0:x1] = 1
+    if m:
+        img[y_mid : y_mid + w, x0:x1] = 1
+    if b:
+        img[y_bot : y_bot + w, x0:x1] = 1
+    if tl:
+        img[y_top : y_mid + w, x0 : x0 + w] = 1
+    if tr:
+        img[y_top : y_mid + w, x1 - w : x1] = 1
+    if bl:
+        img[y_mid : y_bot + w, x0 : x0 + w] = 1
+    if br:
+        img[y_mid : y_bot + w, x1 - w : x1] = 1
+    return img
+
+
+def synthetic_mnist(
+    n_train: int = 8192, n_test: int = 2048, seed: int = 0
+) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """MNIST-shaped synthetic digits: glyphs + shift jitter + pixel noise.
+
+    Returns ``(train, test)`` dicts with ``image`` ``[N, 28, 28, 1]`` float32
+    in [0, 1] and ``label`` int32.
+    """
+    glyphs = np.stack([_glyph(d) for d in range(10)])
+
+    def make(n: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        labels = rng.integers(0, 10, size=n)
+        images = glyphs[labels].copy()
+        # random shifts +-3 px
+        for i in range(n):
+            dx, dy = rng.integers(-3, 4, size=2)
+            images[i] = np.roll(np.roll(images[i], dy, axis=0), dx, axis=1)
+        images += rng.normal(0, 0.25, size=images.shape).astype(np.float32)
+        images = np.clip(images, 0.0, 1.0)
+        return {
+            "image": images[..., None].astype(np.float32),
+            "label": labels.astype(np.int32),
+        }
+
+    rng = np.random.default_rng(seed)
+    return make(n_train, rng), make(n_test, rng)
+
+
+def load_mnist_idx(
+    directory: str,
+    train_images: str = "train-images-idx3-ubyte",
+    train_labels: str = "train-labels-idx1-ubyte",
+    test_images: str = "t10k-images-idx3-ubyte",
+    test_labels: str = "t10k-labels-idx1-ubyte",
+) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """Parse the standard MNIST IDX files (optionally .gz) from a local dir."""
+
+    def read_idx(path: str) -> np.ndarray:
+        opener = gzip.open if path.endswith(".gz") else open
+        if not os.path.exists(path) and os.path.exists(path + ".gz"):
+            path, opener = path + ".gz", gzip.open
+        with opener(path, "rb") as f:
+            magic, = struct.unpack(">H", f.read(4)[2:])
+            dtype_code, ndim = magic >> 8, magic & 0xFF
+            assert dtype_code == 8, f"unsupported IDX dtype {dtype_code}"
+            dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+            return np.frombuffer(f.read(), np.uint8).reshape(dims)
+
+    def split(images_file: str, labels_file: str) -> Dict[str, np.ndarray]:
+        images = read_idx(os.path.join(directory, images_file))
+        labels = read_idx(os.path.join(directory, labels_file))
+        return {
+            "image": (images.astype(np.float32) / 255.0)[..., None],
+            "label": labels.astype(np.int32),
+        }
+
+    return (
+        split(train_images, train_labels),
+        split(test_images, test_labels),
+    )
+
+
+def mnist(
+    data_dir: Optional[str] = None, **synthetic_kwargs
+) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
+    """Real MNIST when ``data_dir`` (or ``$MNIST_DIR``) holds the IDX files;
+    synthetic otherwise."""
+    data_dir = data_dir or os.environ.get("MNIST_DIR")
+    if data_dir and os.path.isdir(data_dir):
+        return load_mnist_idx(data_dir)
+    return synthetic_mnist(**synthetic_kwargs)
+
+
+def synthetic_lm_tokens(
+    n_docs: int = 512, seq_len: int = 256, vocab: int = 512, seed: int = 0
+) -> Dict[str, np.ndarray]:
+    """Markov-chain token streams — compressible structure an LM can learn
+    (unlike uniform noise, the loss has somewhere to go)."""
+    rng = np.random.default_rng(seed)
+    # sparse transition table: each token strongly prefers ~4 successors
+    nexts = rng.integers(0, vocab, size=(vocab, 4))
+    tokens = np.empty((n_docs, seq_len), np.int32)
+    state = rng.integers(0, vocab, size=n_docs)
+    for t in range(seq_len):
+        tokens[:, t] = state
+        choice = nexts[state, rng.integers(0, 4, size=n_docs)]
+        noise = rng.integers(0, vocab, size=n_docs)
+        state = np.where(rng.random(n_docs) < 0.9, choice, noise)
+    return {"tokens": tokens}

@@ -1,0 +1,22 @@
+from rocket_tpu.engine.adapter import FlaxModel, ModelAdapter, state_shardings
+from rocket_tpu.engine.precision import Policy
+from rocket_tpu.engine.state import TrainState, param_count
+from rocket_tpu.engine.step import (
+    Objective,
+    build_eval_step,
+    build_loss_fn,
+    build_train_step,
+)
+
+__all__ = [
+    "FlaxModel",
+    "ModelAdapter",
+    "Objective",
+    "Policy",
+    "TrainState",
+    "build_eval_step",
+    "build_loss_fn",
+    "build_train_step",
+    "param_count",
+    "state_shardings",
+]

@@ -1,0 +1,188 @@
+"""Model adapters — bridge user model definitions to the engine's ApplyFn.
+
+The reference wraps a ``torch.nn.Module`` whose ``forward(batch)`` returns an
+updated batch (``rocket/core/module.py:50-60,139``).  The TPU engine needs
+the functional equivalent: explicit params/mutable pytrees and a pure apply.
+:class:`FlaxModel` adapts any ``flax.linen`` module with a
+``__call__(batch, train=...)`` signature; anything else can implement the
+:class:`ModelAdapter` protocol directly.
+
+Sharded initialization: parameters annotated with
+``flax.linen.with_partitioning`` carry *logical* axis names; this adapter
+resolves them through :class:`rocket_tpu.parallel.sharding.ShardingRules`
+into :class:`jax.sharding.NamedSharding` and jit-initializes with
+``out_shardings`` so big models materialize directly sharded across the
+mesh (no host-RAM staging, no replicate-then-shard traffic).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from rocket_tpu.parallel.sharding import DEFAULT_RULES, ShardingRules, replicated
+
+
+class ModelAdapter:
+    """Protocol every engine-compatible model exposes."""
+
+    def init_variables(self, rng: jax.Array, batch: Any) -> Tuple[Any, Any]:
+        """Return ``(params, mutable)`` pytrees for a sample batch."""
+        raise NotImplementedError
+
+    def apply_fn(
+        self, params: Any, mutable: Any, rng: jax.Array, batch: Any, train: bool
+    ) -> Tuple[Any, Any]:
+        """Pure forward: return ``(batch_out, new_mutable)``."""
+        raise NotImplementedError
+
+    def partition_specs(
+        self, abstract_params: Any, rules: ShardingRules
+    ) -> Any:
+        """PartitionSpec pytree matching ``abstract_params`` (default:
+        fully replicated)."""
+        return jax.tree_util.tree_map(lambda _: PartitionSpec(), abstract_params)
+
+
+class FlaxModel(ModelAdapter):
+    """Adapter for ``flax.linen`` modules.
+
+    The wrapped module's ``__call__`` takes the batch (an
+    ``Attributes``/dict) plus ``train: bool`` and returns the updated batch —
+    the same blackboard-rewriting contract as the reference's
+    ``module.forward(attrs.batch)`` (``module.py:139``).
+
+    Parameters
+    ----------
+    module:
+        The linen module.
+    rng_collections:
+        PRNG stream names threaded during training (default ``('dropout',)``).
+    mutable_collections:
+        Non-param variable collections updated during training (e.g.
+        ``('batch_stats',)`` for BatchNorm). Auto-detected at init.
+    """
+
+    def __init__(
+        self,
+        module: Any,
+        rng_collections: Sequence[str] = ("dropout",),
+        mutable_collections: Optional[Sequence[str]] = None,
+    ) -> None:
+        self.module = module
+        self.rng_collections = tuple(rng_collections)
+        self._mutable_collections = (
+            tuple(mutable_collections) if mutable_collections is not None else None
+        )
+        self._mesh = None
+        self._rules = None
+
+    def configure(self, mesh, rules) -> None:
+        """Give the adapter the mesh/rules so activation-sharding
+        constraints inside the model (``parallel.context.constrain``)
+        resolve during tracing.  Called by Module.materialize."""
+        self._mesh = mesh
+        self._rules = rules
+
+    def apply_policy(self, policy) -> None:
+        """Thread the precision policy's compute dtype into modules exposing
+        a ``dtype`` attribute left at ``None`` (the vision model families):
+        they cast their own input leaves to it, which keeps uint8 loaders
+        honest under bf16 without the engine touching supervision targets.
+        Called by Module.materialize before init."""
+        module = self.module
+        if getattr(module, "dtype", "absent") is None:
+            self.module = module.clone(dtype=policy.compute_dtype)
+
+    def _ctx(self):
+        from rocket_tpu.parallel.context import mesh_context
+
+        if self._mesh is None:
+            import contextlib
+
+            return contextlib.nullcontext()
+        return mesh_context(self._mesh, self._rules)
+
+    def _rngs(self, rng: jax.Array) -> Dict[str, jax.Array]:
+        keys = jax.random.split(rng, len(self.rng_collections))
+        return dict(zip(self.rng_collections, keys))
+
+    def init_variables(self, rng: jax.Array, batch: Any) -> Tuple[Any, Any]:
+        init_rngs = dict(self._rngs(rng), params=rng)
+        with self._ctx():
+            variables = self.module.init(init_rngs, batch, train=False)
+        variables = dict(variables)
+        params = variables.pop("params", {})
+        mutable = variables
+        if self._mutable_collections is None:
+            self._mutable_collections = tuple(sorted(mutable.keys()))
+        return params, mutable
+
+    def apply_fn(
+        self, params: Any, mutable: Any, rng: jax.Array, batch: Any, train: bool
+    ) -> Tuple[Any, Any]:
+        collections = self._mutable_collections or tuple(sorted(dict(mutable)))
+        variables = {"params": params, **dict(mutable)}
+        rngs = self._rngs(rng) if train else None
+        with self._ctx():
+            if train and collections:
+                batch_out, updated = self.module.apply(
+                    variables, batch, train=True, rngs=rngs, mutable=list(collections)
+                )
+                return batch_out, dict(updated)
+            batch_out = self.module.apply(variables, batch, train=train, rngs=rngs)
+        return batch_out, mutable
+
+    def partition_specs(self, abstract_params: Any, rules: ShardingRules) -> Any:
+        import flax.linen as nn
+
+        logical = nn.get_partition_spec(abstract_params)
+
+        def resolve(spec: Any) -> PartitionSpec:
+            if not isinstance(spec, PartitionSpec):
+                return PartitionSpec()
+            return rules.spec(*spec)
+
+        return jax.tree_util.tree_map(
+            resolve,
+            logical,
+            is_leaf=lambda x: isinstance(x, PartitionSpec) or x is None,
+        )
+
+
+def state_shardings(
+    mesh: Mesh,
+    abstract_state: Any,
+    param_specs: Any,
+) -> Any:
+    """NamedShardings for a full TrainState given the param PartitionSpecs.
+
+    ``opt_state``/``grad_accum`` leaves inherit the sharding of the param
+    they mirror (matched by tree-path suffix AND shape); everything else
+    (counters, rng, scalars) is replicated — the GSPMD analogue of accelerate
+    keeping optimizer state co-located with its params.
+    """
+    abstract_params = abstract_state.params
+    flat_specs, _ = jax.tree_util.tree_flatten_with_path(param_specs)
+    flat_params, _ = jax.tree_util.tree_flatten_with_path(abstract_params)
+    # (path, shape) -> spec; paths are stringified key tuples.
+    param_table = {}
+    for (ppath, pleaf), (_, spec) in zip(flat_params, flat_specs):
+        key = tuple(str(p) for p in ppath)
+        param_table[key] = (getattr(pleaf, "shape", None), spec)
+
+    def shard_for(path, leaf) -> NamedSharding:
+        shape = getattr(leaf, "shape", None)
+        key = tuple(str(p) for p in path)
+        for plen in range(len(key), 0, -1):
+            suffix = key[-plen:]
+            hit = param_table.get(suffix)
+            if hit is not None and hit[0] == shape:
+                return NamedSharding(mesh, hit[1])
+        return replicated(mesh)
+
+    flat_state, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
+    shardings = [shard_for(path, leaf) for path, leaf in flat_state]
+    return jax.tree_util.tree_unflatten(treedef, shardings)

@@ -1,0 +1,60 @@
+"""Mixed-precision policy — the AMP/grad-scaler replacement.
+
+Reference: ``mixed_precision`` Launcher arg (``launcher.py:100,187``) +
+``accelerator.autocast()`` (``module.py:210``) + torch grad-scaler.  On TPU,
+bf16 has the same exponent range as f32, so there is no loss-scaling; a
+policy is just three dtypes: params are kept in ``param_dtype``, activations
+computed in ``compute_dtype``, step outputs (loss/metrics) in
+``output_dtype``.  XLA fuses the casts into adjacent ops.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+def _cast_floating(tree: Any, dtype: Any) -> Any:
+    def cast(leaf: Any) -> Any:
+        if hasattr(leaf, "dtype") and jnp.issubdtype(leaf.dtype, jnp.floating):
+            return leaf.astype(dtype)
+        return leaf
+
+    return jax.tree_util.tree_map(cast, tree)
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_string(cls, name: str) -> "Policy":
+        """Accepts the reference's ``mixed_precision`` vocabulary: ``'no'``
+        (all f32), ``'bf16'`` (f32 params, bf16 compute — the autocast
+        analogue), ``'bf16_full'`` (bf16 params too, halves HBM), ``'fp16'``
+        is accepted as an alias of ``'bf16'`` (TPU has no fp16 path)."""
+        name = (name or "no").lower()
+        if name in ("no", "none", "f32", "fp32", "float32"):
+            return cls()
+        if name in ("bf16", "bfloat16", "fp16", "float16"):
+            return cls(compute_dtype=jnp.bfloat16)
+        if name in ("bf16_full", "pure_bf16"):
+            return cls(
+                param_dtype=jnp.bfloat16,
+                compute_dtype=jnp.bfloat16,
+            )
+        raise ValueError(f"unknown mixed_precision {name!r}")
+
+    def cast_to_compute(self, tree: Any) -> Any:
+        return _cast_floating(tree, self.compute_dtype)
+
+    def cast_to_param(self, tree: Any) -> Any:
+        return _cast_floating(tree, self.param_dtype)
+
+    def cast_to_output(self, tree: Any) -> Any:
+        return _cast_floating(tree, self.output_dtype)

@@ -1,0 +1,90 @@
+"""TrainState — the explicit, immutable pytree that replaces mutable
+framework objects.
+
+In the reference, training state is scattered across mutable registries
+inside the ``Accelerator`` (``_models``, ``_optimizers``, ``_schedulers``,
+``_custom_objects`` — SURVEY §7.1; e.g. ``rocket/core/module.py:106``,
+``optimizer.py:109``).  The TPU build makes it one functional pytree that a
+jitted, donated-argument ``train_step(state, batch)`` threads through the
+run — the shape XLA wants (static structure, buffer donation, no Python
+mutation in the hot path).
+
+Contents:
+
+- ``step``        — effective optimizer-step counter (int32 scalar array).
+- ``params``      — model parameters (possibly sharded via GSPMD).
+- ``opt_state``   — optax optimizer state.
+- ``rng``         — PRNG key threaded through stochastic layers (dropout).
+- ``mutable``     — non-parameter model collections (e.g. BatchNorm
+  ``batch_stats``); empty dict when unused.
+- ``grad_accum``  — running gradient sum for micro-batching; ``None`` when
+  ``gradient_accumulation_steps == 1`` (reference's ``accumulate()`` window,
+  ``module.py:211``).
+- ``micro``       — micro-step counter inside the accumulation window.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+
+@struct.dataclass
+class TrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    rng: jax.Array
+    mutable: Any = struct.field(default_factory=dict)
+    grad_accum: Optional[Any] = None
+    micro: Optional[jax.Array] = None
+
+    @classmethod
+    def create(
+        cls,
+        params: Any,
+        tx: Any,
+        rng: Optional[jax.Array] = None,
+        mutable: Optional[Any] = None,
+        gradient_accumulation_steps: int = 1,
+    ) -> "TrainState":
+        """Build an initial state from params + an optax transform.
+
+        ``tx.init`` runs under ``jax.eval_shape``-compatible tracing, so this
+        is safe to call inside ``jax.jit`` for sharded initialization.
+        """
+        opt_state = tx.init(params)
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        grad_accum = None
+        micro = None
+        if gradient_accumulation_steps > 1:
+            grad_accum = jax.tree_util.tree_map(jnp.zeros_like, params)
+            micro = jnp.zeros((), dtype=jnp.int32)
+        return cls(
+            step=jnp.zeros((), dtype=jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            rng=rng,
+            mutable=mutable if mutable is not None else {},
+            grad_accum=grad_accum,
+            micro=micro,
+        )
+
+
+def param_count(params: Any) -> int:
+    """Total number of parameters in a pytree."""
+    return sum(
+        int(x.size) for x in jax.tree_util.tree_leaves(params) if hasattr(x, "size")
+    )
+
+
+def abstract_state(
+    init_fn: Callable[[], TrainState],
+) -> TrainState:
+    """Shape/dtype skeleton of a state without allocating it — used to derive
+    shardings before real (possibly distributed) initialization."""
+    return jax.eval_shape(init_fn)

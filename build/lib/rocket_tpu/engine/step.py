@@ -1,0 +1,202 @@
+"""Jitted step builders — the execution core.
+
+SURVEY §7.1: the per-iteration work the reference does in Python (forward →
+loss → backward → step, ``module.py:110-142`` → ``loss.py:64-119`` →
+``optimizer.py:111-147`` → ``scheduler.py:94-113``) becomes ONE pure,
+donated-argument function compiled by XLA under a ``jax.sharding.Mesh``:
+
+    ``state, logs = train_step(state, batch)``
+
+What the compiler swallows (vs the reference's per-iteration Python):
+
+- forward + backward — XLA-fused kernels on the MXU, bf16 per the policy
+  (replaces autocast, ``module.py:210``);
+- gradient all-reduce — inserted by GSPMD because the batch is sharded over
+  the ``data``/``fsdp`` axes while params are replicated/sharded (replaces
+  DDP's bucketed NCCL all-reduce armed in ``accelerator.prepare``,
+  ``module.py:106``);
+- the cross-process loss mean — the reference blocks on
+  ``accelerator.gather(loss).mean()`` EVERY micro-batch purely for logging
+  (``loss.py:95``, flagged as a defect in SURVEY §2.4); here ``jnp.mean``
+  over the globally-sharded batch IS the global mean, compiled into the same
+  program — zero extra launches;
+- optimizer + scheduler step — optax transform application.
+
+Gradient accumulation (reference ``accumulate()`` ctx + ``sync_gradients``
+gating, ``module.py:211``, ``loss.py:101``, ``optimizer.py:133``) compiles to
+TWO step variants instead of a data-dependent branch:
+
+- ``micro`` — fwd/bwd, add grads into ``state.grad_accum``, no update;
+- ``sync``  — fwd/bwd, apply ``(accum + g) / n`` through optax, reset.
+
+The host picks the variant by a Python counter (the accumulation boundary is
+statically known), so neither program contains dynamic control flow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from rocket_tpu.engine.precision import Policy
+from rocket_tpu.engine.state import TrainState
+
+# ``apply_fn(params, mutable, rng, batch, train)`` -> ``(batch_out, mutable)``
+# — the model rewrites the batch blackboard-style, the functional analogue of
+# ``attrs.batch = module.forward(attrs.batch)`` (reference ``module.py:139``).
+ApplyFn = Callable[[Any, Any, jax.Array, Any, bool], Tuple[Any, Any]]
+
+# ``objective(batch_out)`` -> scalar loss or ``(scalar, aux_logs)``.
+ObjectiveFn = Callable[[Any], Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class Objective:
+    """A named, weighted loss term (reference ``Loss`` capsule config,
+    ``loss.py:51-62``)."""
+
+    name: str
+    fn: ObjectiveFn
+    weight: float = 1.0
+
+
+def _call_objective(obj: Objective, batch: Any) -> Tuple[jax.Array, Dict[str, Any]]:
+    out = obj.fn(batch)
+    if isinstance(out, tuple):
+        value, aux = out
+    else:
+        value, aux = out, {}
+    return jnp.asarray(value), dict(aux)
+
+
+def _total_loss(
+    objectives: Sequence[Objective], batch: Any
+) -> Tuple[jax.Array, Dict[str, Any]]:
+    logs: Dict[str, Any] = {}
+    total = jnp.zeros((), dtype=jnp.float32)
+    for obj in objectives:
+        value, aux = _call_objective(obj, batch)
+        logs[obj.name] = value
+        for k, v in aux.items():
+            logs[f"{obj.name}/{k}"] = v
+        total = total + obj.weight * value.astype(jnp.float32)
+    logs["loss"] = total
+    return total, logs
+
+
+def build_loss_fn(
+    apply_fn: ApplyFn,
+    objectives: Sequence[Objective],
+    policy: Policy,
+):
+    """``(params, mutable, rng, batch) -> (loss, (logs, mutable, batch_out))``
+    with the precision policy applied around the forward pass."""
+
+    def loss_fn(params, mutable, rng, batch):
+        # Autocast analogue (reference ``module.py:210``): params enter the
+        # model in the compute dtype; the model families cast their own
+        # INPUT leaves (images/tokens) to it.  The batch itself is NOT cast —
+        # supervision targets and masks must keep full precision for the
+        # objectives.
+        compute_params = policy.cast_to_compute(params)
+        batch_out, new_mutable = apply_fn(compute_params, mutable, rng, batch, True)
+        total, logs = _total_loss(objectives, batch_out)
+        return total, (logs, new_mutable, batch_out)
+
+    return loss_fn
+
+
+def build_train_step(
+    apply_fn: ApplyFn,
+    objectives: Sequence[Objective],
+    tx: optax.GradientTransformation,
+    policy: Policy = Policy(),
+    gradient_accumulation_steps: int = 1,
+    log_grad_norm: bool = True,
+    donate: bool = True,
+) -> Dict[str, Callable[[TrainState, Any], Tuple[TrainState, Dict[str, Any]]]]:
+    """Build the jitted training step(s).
+
+    Returns ``{"sync": fn}`` when not accumulating, else
+    ``{"sync": fn, "micro": fn}`` — the host calls ``micro`` for the first
+    ``n-1`` batches of each window and ``sync`` on the boundary (reference
+    ``sync_gradients`` cadence, ``loss.py:101``/``optimizer.py:133``).
+    """
+    if gradient_accumulation_steps < 1:
+        raise ValueError("gradient_accumulation_steps must be >= 1")
+    loss_fn = build_loss_fn(apply_fn, objectives, policy)
+    grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+    n = gradient_accumulation_steps
+
+    def forward_backward(state: TrainState, batch: Any):
+        rng = jax.random.fold_in(state.rng, state.step)
+        if state.micro is not None:
+            rng = jax.random.fold_in(rng, state.micro)
+        (loss, (logs, new_mutable, _)), grads = grad_fn(
+            state.params, state.mutable, rng, batch
+        )
+        return grads, new_mutable, logs
+
+    def micro_step(state: TrainState, batch: Any):
+        grads, new_mutable, logs = forward_backward(state, batch)
+        accum = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
+        new_state = state.replace(
+            grad_accum=accum,
+            mutable=new_mutable,
+            micro=state.micro + 1,
+        )
+        return new_state, logs
+
+    def sync_step(state: TrainState, batch: Any):
+        grads, new_mutable, logs = forward_backward(state, batch)
+        if n > 1:
+            grads = jax.tree_util.tree_map(
+                lambda a, g: (a + g) / n, state.grad_accum, grads
+            )
+        if log_grad_norm:
+            logs["grad_norm"] = optax.global_norm(grads)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        replacements = dict(
+            step=state.step + 1,
+            params=new_params,
+            opt_state=new_opt_state,
+            mutable=new_mutable,
+        )
+        if n > 1:
+            replacements["grad_accum"] = jax.tree_util.tree_map(
+                jnp.zeros_like, state.grad_accum
+            )
+            replacements["micro"] = jnp.zeros((), dtype=jnp.int32)
+        return state.replace(**replacements), logs
+
+    donate_argnums = (0,) if donate else ()
+    steps = {"sync": jax.jit(sync_step, donate_argnums=donate_argnums)}
+    if n > 1:
+        steps["micro"] = jax.jit(micro_step, donate_argnums=donate_argnums)
+    return steps
+
+
+def build_eval_step(
+    apply_fn: ApplyFn,
+    objectives: Sequence[Objective] = (),
+    policy: Policy = Policy(),
+) -> Callable[[TrainState, Any], Tuple[Any, Dict[str, Any]]]:
+    """Jitted evaluation step: forward only (reference eval path — grads off
+    make Loss/Optimizer/Scheduler no-ops, ``loss.py:88-89``,
+    ``optimizer.py:128``).  Returns ``(batch_out, logs)`` — the augmented
+    batch feeds Meter/Metric capsules downstream (``meter.py:63-105``)."""
+
+    def eval_step(state: TrainState, batch: Any):
+        params = policy.cast_to_compute(state.params)
+        batch_out, _ = apply_fn(params, state.mutable, state.rng, batch, False)
+        logs: Dict[str, Any] = {}
+        if objectives:
+            _, logs = _total_loss(objectives, batch_out)
+        return batch_out, logs
+
+    return jax.jit(eval_step)

@@ -1,0 +1,4 @@
+from rocket_tpu.launch.launcher import Launcher
+from rocket_tpu.launch.loop import Looper
+
+__all__ = ["Launcher", "Looper"]

@@ -1,0 +1,243 @@
+"""Launcher — the root of the capsule tree; owns the run.
+
+Capability parity: reference ``rocket/core/launcher.py:37-448``:
+
+- versioned experiment dirs ``<root>/<tag>/v0,v1,…`` resolved once and
+  broadcast to every host (``launcher.py:125-150``), mkdir on the main
+  process + barrier (``:152-161``);
+- creates the execution context at setup and injects it into the whole tree
+  (Accelerator there → :class:`~rocket_tpu.runtime.Runtime` here,
+  ``:185-193``);
+- the epoch loop: ``attrs.launcher.epoch_idx`` then ``set → launch → reset``
+  on every child per epoch (``:278-286``);
+- resume: full (weights + capsule states) or weights-only, with the
+  identical-topology guard (``:319-375``); epoch loop restarts at the
+  restored ``epoch_idx`` (``:278``);
+- teardown in reverse order + process-group shutdown (``:293-317``).
+
+TPU-first: process bring-up is ``jax.distributed`` (one process per host —
+the TPU runtime pre-wires ICI; ``notebook_launcher``'s fork-N-workers model
+does not exist on TPU pods, so ``launch()`` is the single entry point);
+mixed precision is a dtype policy, not autocast; and checkpoint restore is
+sharded Orbax, not pickled ``load_state``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Iterable, Optional, Union
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.core.dispatcher import Dispatcher
+from rocket_tpu.parallel import multihost
+from rocket_tpu.runtime import Runtime
+
+
+class Launcher(Dispatcher):
+    """Parameters
+    ----------
+    capsules:
+        Top-level children — typically Loopers (train, eval).
+    tag:
+        Experiment name; enables the versioned project dir. ``None`` = no
+        project dir (and Checkpointer/Tracker that need one will complain,
+        reference ``checkpoint.py:75-81``).
+    num_epochs:
+        Epoch-loop length (reference ``launcher.py:101``).
+    mesh:
+        ``jax.sharding.Mesh`` / ``MeshSpec`` / ``None`` (all devices on the
+        data axis — the reference's DDP topology).
+    mixed_precision / gradient_accumulation_steps / seed:
+        Runtime policy knobs (reference ``launcher.py:100-101``).
+    project_root:
+        Parent of experiment dirs (default ``./experiments``).
+    """
+
+    def __init__(
+        self,
+        capsules: Iterable[Capsule] = (),
+        tag: Optional[str] = None,
+        num_epochs: int = 1,
+        mesh: Any = None,
+        mixed_precision: str = "no",
+        gradient_accumulation_steps: int = 1,
+        seed: int = 0,
+        project_root: str = "experiments",
+        runtime: Optional[Runtime] = None,
+        statefull: bool = True,
+        priority: int = 1000,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(
+            capsules=capsules, statefull=statefull, priority=priority, logger=logger
+        )
+        self._tag = tag
+        self._num_epochs = int(num_epochs)
+        self._mesh = mesh
+        self._mixed_precision = mixed_precision
+        self._grad_accum = int(gradient_accumulation_steps)
+        self._seed = int(seed)
+        self._project_root = project_root
+        self._external_runtime = runtime
+        self._epoch_idx = 0
+        self._resume_path: Optional[str] = None
+        self._resume_load_capsules = True
+
+    # -- project dirs --------------------------------------------------------
+
+    def _resolve_project_dir(self) -> Optional[str]:
+        """Next free ``<root>/<tag>/v{N}``, agreed across hosts (reference
+        ``launcher.py:125-150``)."""
+        if self._tag is None:
+            return None
+        base = os.path.join(self._project_root, self._tag)
+        version = 0
+        if os.path.isdir(base):
+            versions = [
+                int(name[1:])
+                for name in os.listdir(base)
+                if name.startswith("v") and name[1:].isdigit()
+            ]
+            version = max(versions) + 1 if versions else 0
+        path = os.path.join(base, f"v{version}")
+        # All hosts must agree on the dir (clocks/list races) — host 0 decides.
+        path = multihost.broadcast_object(path)
+        return path
+
+    def _create_project_dir(self, runtime: Runtime) -> None:
+        """mkdir on main + barrier (reference ``launcher.py:152-161``)."""
+        if runtime.project_dir is None:
+            return
+        if runtime.is_main_process:
+            os.makedirs(runtime.project_dir, exist_ok=True)
+            os.makedirs(runtime.logging_dir, exist_ok=True)
+        runtime.wait_for_everyone("project-dir")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def setup(self, attrs: Optional[Attributes] = None) -> None:
+        multihost.initialize()
+        runtime = self._external_runtime or Runtime(
+            mesh=self._mesh,
+            mixed_precision=self._mixed_precision,
+            gradient_accumulation_steps=self._grad_accum,
+            seed=self._seed,
+        )
+        runtime.project_dir = self._resolve_project_dir()
+        if runtime.project_dir is not None:
+            runtime.logging_dir = os.path.join(runtime.project_dir, "logs")
+        self.bind(runtime)
+        self._create_project_dir(runtime)
+        if self._resume_path is not None:
+            runtime.resume_spec = Attributes(
+                path=self._resume_path,
+                load_capsules=self._resume_load_capsules,
+            )
+        super().setup(attrs)
+
+    def destroy(self, attrs: Optional[Attributes] = None) -> None:
+        super().destroy(attrs)
+        if self._runtime is not None:
+            self._runtime.end_training()
+        from rocket_tpu.persist.orbax_io import default_io
+
+        default_io().wait()  # drain any in-flight async checkpoint
+
+    # -- resume --------------------------------------------------------------
+
+    def resume(self, path: str, load_capsules: bool = True) -> "Launcher":
+        """Arm a checkpoint restore for the next ``launch()`` (reference
+        ``launcher.py:377-408``). ``load_capsules=False`` = weights only."""
+        self._resume_path = str(path)
+        self._resume_load_capsules = bool(load_capsules)
+        return self
+
+    def _resume(self, attrs: Attributes) -> None:
+        """Restore host-side capsule states right after setup (reference
+        ``launcher.py:319-375``).  Array states (Module) restore lazily at
+        materialization via ``runtime.resume_spec`` — sharded, direct to
+        mesh."""
+        if self._resume_path is None:
+            return
+        from rocket_tpu.persist.orbax_io import default_io
+
+        io = default_io()
+        path = self._resume_path
+        available = set(io.keys(path))
+        if not self._resume_load_capsules:
+            # Weights-only: leave resume_spec armed for Modules, skip the
+            # host states (reference ``launcher.py:349-359``).
+            self._logger.info("weights-only resume from %s", path)
+            return
+        for capsule in self._runtime.checkpointables:
+            key = capsule._ckpt_key
+            if key is None or getattr(capsule, "lazy_state", False):
+                continue  # lazy array state restores at materialization
+            if key not in available:
+                raise RuntimeError(
+                    f"checkpoint {path} has no item {key!r} — was it saved "
+                    f"from a different capsule tree? (reference guard, "
+                    f"launcher.py:364-369)"
+                )
+            state = io.restore_item(path, key)
+            capsule.load_state_dict(Attributes(state))
+        # Topology guard (reference ``launcher.py:370-375``).
+        if (
+            self._saved_num_procs is not None
+            and self._saved_num_procs != self._runtime.process_count
+        ):
+            raise RuntimeError(
+                f"resume topology mismatch: checkpoint was written by "
+                f"{self._saved_num_procs} processes, this run has "
+                f"{self._runtime.process_count}. Elastic resume is not "
+                f"supported (reference launcher.py:370-375)."
+            )
+        self._logger.info(
+            "resumed from %s at epoch %d", path, self._epoch_idx
+        )
+
+    # -- the run -------------------------------------------------------------
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        """The whole program (reference ``launcher.py:256-291``)."""
+        attrs = attrs if attrs is not None else Attributes()
+        attrs.launcher = Attributes(
+            num_procs=multihost.process_count(),
+            num_nodes=multihost.process_count(),  # one process per TPU host
+            epoch_idx=0,
+        )
+        self.setup(attrs)
+        try:
+            self._resume(attrs)
+            for epoch in range(self._epoch_idx, self._num_epochs):
+                self._epoch_idx = epoch
+                attrs.launcher.epoch_idx = epoch
+                for capsule in self._capsules:
+                    capsule.set(attrs)
+                    capsule.launch(attrs)
+                    capsule.reset(attrs)
+            self._epoch_idx = self._num_epochs
+        finally:
+            del attrs.launcher
+            self.destroy(attrs)
+
+    # -- state ---------------------------------------------------------------
+
+    _saved_num_procs: Optional[int] = None
+
+    def state_dict(self) -> Attributes:
+        # The running epoch: resume re-enters it, and the Dataset's
+        # batch_idx fast-forwards to the intra-epoch position (reference
+        # ``launcher.py:410-425`` + ``dataset.py:205-210``).
+        return Attributes(
+            epoch_idx=self._epoch_idx,
+            num_procs=multihost.process_count(),
+            num_nodes=multihost.process_count(),
+        )
+
+    def load_state_dict(self, state: Attributes) -> None:
+        if not state:
+            return
+        self._epoch_idx = int(state["epoch_idx"])
+        self._saved_num_procs = int(state["num_procs"])

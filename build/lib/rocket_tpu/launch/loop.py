@@ -1,0 +1,207 @@
+"""Looper — the iteration loop over one cycle (train epoch / eval pass).
+
+Capability parity: reference ``rocket/core/loop.py:25-323``:
+
+- ``run_every`` gating: the cycle runs only when ``epoch % run_every == 0``
+  (``loop.py:109-113``) — e.g. evaluate every 5th epoch;
+- repeats inference from child ``Dataset`` totals (``loop.py:312-319``);
+- the ``attrs.looper`` protocol: ``{repeats, state, terminate, tag,
+  grad_enabled}`` published at ``set`` (``loop.py:152-158``), removed at
+  ``reset`` (``loop.py:180``);
+- per-iteration: clear ``attrs.batch``, dispatch to children in priority
+  order, honor the termination vote (``loop.py:213-226``);
+- no nested Loopers (``loop.py:287-292``);
+- ``iter_idx`` in the checkpoint state (``loop.py:231-263``).
+
+TPU-first: the reference toggles ``torch.set_grad_enabled`` around the body
+(``loop.py:217``) — a global mutable switch.  Here train-vs-eval is a
+*declarative* flag on the blackboard (``attrs.looper.grad_enabled``) that the
+Module reads to pick its jitted train or eval step; nothing global mutates.
+The tqdm status line reads device scalars lazily and refreshes every
+``refresh_every`` iterations so progress display never stalls the async
+dispatch queue.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, Optional
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.core.dispatcher import Dispatcher
+
+try:
+    from termcolor import colored
+except ImportError:  # pragma: no cover
+
+    def colored(text: str, *args: Any, **kwargs: Any) -> str:
+        return text
+
+
+class Looper(Dispatcher):
+    """Parameters
+    ----------
+    capsules:
+        Children dispatched each iteration (Dataset, Module, Meter, Tracker,
+        Checkpointer, ...).
+    grad_enabled:
+        ``True`` = training cycle, ``False`` = evaluation cycle (reference
+        ``loop.py:70-89``).
+    repeats:
+        Iterations per cycle; ``None`` infers from child Dataset totals
+        (reference ``loop.py:294-319``).
+    run_every:
+        Run the cycle only on epochs divisible by this (``loop.py:91-113``).
+    tag:
+        Progress-bar label (default TRAIN/EVAL by grad mode).
+    """
+
+    def __init__(
+        self,
+        capsules: Iterable[Capsule] = (),
+        grad_enabled: bool = True,
+        repeats: Optional[int] = None,
+        run_every: int = 1,
+        tag: Optional[str] = None,
+        progress: bool = True,
+        refresh_every: int = 10,
+        statefull: bool = True,
+        priority: int = 1000,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(
+            capsules=capsules, statefull=statefull, priority=priority, logger=logger
+        )
+        self._grad_enabled = grad_enabled
+        self._repeats = repeats
+        self._explicit_repeats = repeats
+        if run_every < 1:
+            raise ValueError("run_every must be >= 1")
+        self._run_every = run_every
+        self._tag = tag or ("TRAIN" if grad_enabled else "EVAL")
+        self._progress = progress
+        self._refresh_every = max(1, refresh_every)
+        self._iter_idx = 0
+
+    def guard(self) -> None:
+        super().guard()
+        for capsule in self._capsules:
+            if isinstance(capsule, Looper):
+                raise RuntimeError(
+                    "nested Loopers are not allowed (reference loop.py:287-292)"
+                )
+
+    # -- cycle gating --------------------------------------------------------
+
+    def run_if_needed(self, attrs: Optional[Attributes]) -> bool:
+        epoch = 0
+        if attrs is not None and attrs.launcher is not None:
+            epoch = int(attrs.launcher.epoch_idx or 0)
+        return epoch % self._run_every == 0
+
+    def infer_repeats(self) -> int:
+        """Sum of child Dataset totals (reference ``loop.py:294-319``)."""
+        from rocket_tpu.data.dataset import Dataset
+
+        totals = [
+            c.total
+            for c in self._capsules
+            if isinstance(c, Dataset) and c.total is not None
+        ]
+        if not totals:
+            raise RuntimeError(
+                f"Looper[{self._tag}]: repeats not given and no child Dataset "
+                f"to infer them from"
+            )
+        return sum(totals)
+
+    # -- events --------------------------------------------------------------
+
+    def set(self, attrs: Optional[Attributes] = None) -> None:
+        attrs = attrs if attrs is not None else Attributes()
+        if not self.run_if_needed(attrs):
+            return
+        if self._explicit_repeats is None:
+            self._repeats = self.infer_repeats()
+        attrs.looper = Attributes(
+            repeats=self._repeats,
+            state=Attributes(),
+            terminate=False,
+            tag=self._tag,
+            grad_enabled=self._grad_enabled,
+        )
+        super().set(attrs)
+
+    def reset(self, attrs: Optional[Attributes] = None) -> None:
+        if attrs is None or attrs.looper is None:
+            return
+        super().reset(attrs)
+        del attrs.looper
+        self._iter_idx = 0
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        attrs = attrs if attrs is not None else Attributes()
+        if not self.run_if_needed(attrs):
+            return
+        if attrs.looper is None:
+            self.set(attrs)
+        looper = attrs.looper
+        bar = self._status_bar(looper.repeats)
+        start = self._iter_idx
+        try:
+            for _ in range(start, looper.repeats):
+                attrs.batch = None
+                for capsule in self._capsules:
+                    capsule.launch(attrs)
+                self._iter_idx += 1
+                if looper.terminate:
+                    break
+                if bar is not None:
+                    bar.update(1)
+                    if self._iter_idx % self._refresh_every == 0:
+                        bar.set_postfix(self._format_state(looper.state))
+        finally:
+            if bar is not None:
+                bar.set_postfix(self._format_state(looper.state))
+                bar.close()
+        attrs.batch = None
+
+    # -- progress ------------------------------------------------------------
+
+    def _status_bar(self, repeats: int):
+        if not self._progress:
+            return None
+        if self._runtime is not None and not self._runtime.is_main_process:
+            return None
+        from tqdm import tqdm
+
+        color = "green" if self._grad_enabled else "cyan"
+        return tqdm(
+            total=repeats,
+            initial=self._iter_idx,
+            desc=colored(self._tag, color),
+            leave=True,
+            dynamic_ncols=True,
+        )
+
+    @staticmethod
+    def _format_state(state: Optional[Attributes]) -> dict:
+        if not state:
+            return {}
+        out = {}
+        for key, value in state.items():
+            try:
+                out[key] = f"{float(value):.4g}"  # device sync, throttled
+            except (TypeError, ValueError):
+                out[key] = str(value)
+        return out
+
+    # -- state ---------------------------------------------------------------
+
+    def state_dict(self) -> Attributes:
+        return Attributes(iter_idx=self._iter_idx)
+
+    def load_state_dict(self, state: Attributes) -> None:
+        if not state:
+            return
+        self._iter_idx = int(state["iter_idx"])

@@ -1,0 +1,28 @@
+from rocket_tpu.models import objectives
+from rocket_tpu.models.layers import Embed, PDense, RMSNorm, apply_rope, rotary_embedding
+from rocket_tpu.models.lenet import LeNet
+from rocket_tpu.models.lora import freeze_non_lora, freeze_where, lora_labels, merge_lora
+from rocket_tpu.models.resnet import ResNet, resnet18, resnet50
+from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+from rocket_tpu.models.vit import ViT, ViTConfig
+
+__all__ = [
+    "Embed",
+    "LeNet",
+    "PDense",
+    "RMSNorm",
+    "ResNet",
+    "TransformerConfig",
+    "TransformerLM",
+    "ViT",
+    "ViTConfig",
+    "apply_rope",
+    "freeze_non_lora",
+    "freeze_where",
+    "lora_labels",
+    "merge_lora",
+    "objectives",
+    "resnet18",
+    "resnet50",
+    "rotary_embedding",
+]

@@ -1,0 +1,180 @@
+"""Building-block layers with logical-axis partitioning and optional LoRA.
+
+The reference has no model zoo (models are user torch modules,
+``rocket/core/module.py:50-60``); these layers exist so the TPU build's
+model families (LeNet/ResNet/ViT/transformer LMs) ship with GSPMD sharding
+annotations built in.  Parameters carry *logical* axis names via
+``nn.with_partitioning``; :class:`~rocket_tpu.parallel.sharding.ShardingRules`
+maps them onto mesh axes at materialization (so the same model runs on one
+chip or a tensor/fsdp-sharded pod — only the rules change).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Axes = Tuple[Optional[str], ...]
+
+
+def _init(fn, *logical: Optional[str]):
+    return nn.with_partitioning(fn, logical)
+
+
+def image_input(x: jax.Array, dtype: Any = None) -> jax.Array:
+    """Cast an image batch leaf to the model's compute dtype.
+
+    ``dtype=None`` (no policy threaded): raw integer images become f32,
+    floats keep their dtype.  With a policy compute dtype (the Module clones
+    vision models with ``dtype=policy.compute_dtype``), both integer and
+    float images land in it — so uint8 loaders get honest bf16 too."""
+    if dtype is None:
+        dtype = jnp.float32 if jnp.issubdtype(x.dtype, jnp.integer) else x.dtype
+    return x.astype(dtype)
+
+
+class RMSNorm(nn.Module):
+    """Root-mean-square layer norm (Llama-family norm)."""
+
+    eps: float = 1e-6
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param(
+            "scale", _init(nn.initializers.ones_init(), "norm"), (x.shape[-1],)
+        )
+        var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+        y = x * jax.lax.rsqrt(var + self.eps).astype(x.dtype)
+        return y * scale.astype(x.dtype)
+
+
+class PDense(nn.Module):
+    """Partitioned dense layer with optional fused LoRA adapter.
+
+    ``logical_axes`` names the kernel dims, e.g. ``('embed', 'mlp')``.
+    When ``lora_rank > 0`` a frozen-base + trainable-adapter decomposition
+    is added: ``y = x W + (alpha/r) (x A) B`` with A, B under the
+    ``'lora'`` param prefix so an optax mask can train adapters only
+    (see :func:`rocket_tpu.models.lora.lora_mask`).
+    """
+
+    features: int
+    logical_axes: Axes = (None, None)
+    use_bias: bool = False
+    dtype: Any = jnp.float32
+    kernel_init: Callable = nn.initializers.lecun_normal()
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+
+    @nn.compact
+    def __call__(self, x):
+        in_dim = x.shape[-1]
+        kernel = self.param(
+            "kernel",
+            _init(self.kernel_init, *self.logical_axes),
+            (in_dim, self.features),
+        )
+        y = jnp.einsum("...d,df->...f", x, kernel.astype(x.dtype))
+        if self.lora_rank > 0:
+            a = self.param(
+                "lora_a",
+                _init(nn.initializers.normal(0.02), self.logical_axes[0], None),
+                (in_dim, self.lora_rank),
+            )
+            b = self.param(
+                "lora_b",
+                _init(nn.initializers.zeros_init(), None, self.logical_axes[1]),
+                (self.lora_rank, self.features),
+            )
+            scaling = self.lora_alpha / self.lora_rank
+            y = y + scaling * jnp.einsum(
+                "...d,dr,rf->...f", x, a.astype(x.dtype), b.astype(x.dtype)
+            )
+        if self.use_bias:
+            bias = self.param(
+                "bias",
+                _init(nn.initializers.zeros_init(), self.logical_axes[-1]),
+                (self.features,),
+            )
+            y = y + bias.astype(x.dtype)
+        return y
+
+
+class Embed(nn.Module):
+    """Token embedding, shardable over ``('vocab', 'embed')``; ``attend``
+    reuses the table as a tied LM head."""
+
+    vocab_size: int
+    features: int
+    dtype: Any = None  # None = the table's own dtype (the policy casts it)
+
+    def setup(self):
+        self.embedding = self.param(
+            "embedding",
+            _init(nn.initializers.normal(0.02), "vocab", "embed"),
+            (self.vocab_size, self.features),
+        )
+
+    def __call__(self, tokens):
+        # The precision policy casts params to the compute dtype before
+        # apply, so the table's dtype IS the compute dtype — pinning f32
+        # here would silently upcast the whole residual stream (every
+        # downstream PDense follows activation dtype).
+        table = self.embedding
+        if self.dtype is not None:
+            table = jnp.asarray(table, self.dtype)
+        if self._vocab_sharded():
+            # One-hot matmul instead of gather: a gather from a
+            # vocab-sharded table forces XLA into a full rematerialization
+            # (replicate-then-reshard); the matmul shards cleanly and rides
+            # the MXU — the standard TPU embedding trick.
+            one_hot = jax.nn.one_hot(tokens, self.vocab_size, dtype=table.dtype)
+            return one_hot @ table
+        return table[tokens]
+
+    def _vocab_sharded(self) -> bool:
+        from rocket_tpu.parallel.context import current_mesh, current_rules
+
+        mesh = current_mesh()
+        if mesh is None:
+            return False
+        axes = current_rules().table().get("vocab")
+        if axes is None:
+            return False
+        if isinstance(axes, str):
+            axes = (axes,)
+        size = 1
+        for axis in axes:
+            size *= mesh.shape.get(axis, 1)
+        return size > 1
+
+    def attend(self, x):
+        return jnp.einsum(
+            "...d,vd->...v", x, jnp.asarray(self.embedding, x.dtype)
+        )
+
+
+def rotary_embedding(
+    positions: jax.Array, head_dim: int, theta: float = 10000.0, dtype=jnp.float32
+) -> Tuple[jax.Array, jax.Array]:
+    """cos/sin tables for RoPE; positions ``[B, S]`` -> ``[B, S, 1, D/2]``."""
+    freqs = 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+    angles = positions.astype(jnp.float32)[..., None] * freqs  # [B, S, D/2]
+    return (
+        jnp.cos(angles)[:, :, None, :].astype(dtype),
+        jnp.sin(angles)[:, :, None, :].astype(dtype),
+    )
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """Rotate pairs (split-halves convention) of ``[B, S, H, D]``."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+    ).astype(x.dtype)

@@ -1,0 +1,44 @@
+"""LeNet — the reference's canonical example model.
+
+Capability parity: ``/root/reference/examples/mnist.py:42-79`` defines a
+LeNet-5-style CNN (2 conv + 3 dense) used for the MNIST pipeline.  This is
+the idiomatic flax version following the framework's batch-rewriting model
+contract: ``__call__(batch, train)`` reads ``batch['image']`` (NHWC) and
+returns the batch with ``batch['logits']`` added (reference contract:
+``attrs.batch = module.forward(attrs.batch)``, ``module.py:139``).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from rocket_tpu.core.attributes import Attributes
+
+
+class LeNet(nn.Module):
+    """2×conv + 3×dense classifier (MNIST-shaped by default)."""
+
+    num_classes: int = 10
+    image_key: str = "image"
+    logits_key: str = "logits"
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        x = batch[self.image_key]
+        if x.ndim == 3:  # NHW -> NHWC
+            x = x[..., None]
+        x = x.astype(jnp.float32)
+        x = nn.Conv(6, kernel_size=(5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = nn.Conv(16, kernel_size=(5, 5), padding="VALID")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, window_shape=(2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120)(x))
+        x = nn.relu(nn.Dense(84)(x))
+        logits = nn.Dense(self.num_classes)(x)
+        out = Attributes(batch)
+        out[self.logits_key] = logits
+        return out

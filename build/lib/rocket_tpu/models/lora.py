@@ -1,0 +1,84 @@
+"""LoRA fine-tuning support (the BASELINE.json "Llama-2 7B LoRA" config).
+
+Adapters are created inside :class:`~rocket_tpu.models.layers.PDense` when
+``lora_rank > 0`` (params named ``lora_a``/``lora_b``).  Freezing the base
+model is an optimizer concern — functional JAX has no ``requires_grad``;
+instead the optax transform routes base-weight updates to ``set_to_zero``:
+
+    tx = Optimizer(tx_factory=optax.adamw, learning_rate=1e-4,
+                   wrap=freeze_non_lora)
+
+Gradients for frozen params are still computed (XLA dead-code-eliminates
+most of the unused work); the update is exactly zero, and optimizer moments
+exist only for the adapter leaves that actually train.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import optax
+
+LORA_PREFIXES = ("lora_a", "lora_b")
+
+
+def _is_lora_path(path) -> bool:
+    for part in path:
+        key = getattr(part, "key", None) or getattr(part, "name", None)
+        if key is not None and str(key).startswith("lora_"):
+            return True
+    return False
+
+
+def lora_labels(params: Any) -> Any:
+    """'train' on adapter leaves, 'freeze' elsewhere."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: "train" if _is_lora_path(path) else "freeze", params
+    )
+
+
+def freeze_non_lora(tx: optax.GradientTransformation) -> optax.GradientTransformation:
+    """Only LoRA adapters update; base weights are frozen."""
+    return optax.multi_transform(
+        {"train": tx, "freeze": optax.set_to_zero()}, lora_labels
+    )
+
+
+def freeze_where(
+    predicate: Callable[[tuple, Any], bool]
+) -> Callable[[optax.GradientTransformation], optax.GradientTransformation]:
+    """General freezing combinator: ``predicate(path, leaf) -> True`` means
+    FROZEN. Use as the Optimizer's ``wrap=``."""
+
+    def wrap(tx: optax.GradientTransformation) -> optax.GradientTransformation:
+        def labels(params):
+            return jax.tree_util.tree_map_with_path(
+                lambda p, x: "freeze" if predicate(p, x) else "train", params
+            )
+
+        return optax.multi_transform(
+            {"train": tx, "freeze": optax.set_to_zero()}, labels
+        )
+
+    return wrap
+
+
+def merge_lora(params: Any, alpha: float = 16.0) -> Any:
+    """Fold trained adapters into the base kernels (inference export):
+    ``W' = W + (alpha/r) A @ B``; adapter leaves are zeroed afterwards."""
+    import jax.numpy as jnp
+
+    def merge(node: Any) -> Any:
+        if not isinstance(node, dict):
+            return node
+        out = {k: merge(v) for k, v in node.items()}
+        if "kernel" in out and "lora_a" in out and "lora_b" in out:
+            a, b = out["lora_a"], out["lora_b"]
+            rank = a.shape[-1]
+            out["kernel"] = out["kernel"] + (alpha / rank) * (a @ b)
+            out["lora_a"] = jnp.zeros_like(a)
+            out["lora_b"] = jnp.zeros_like(b)
+        return out
+
+    return merge(params)

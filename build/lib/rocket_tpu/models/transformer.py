@@ -1,0 +1,332 @@
+"""Decoder-only transformer LM — the framework's flagship model family.
+
+One configurable implementation covers the BASELINE.json ladder:
+
+- **GPT-2 style** (``TransformerConfig.gpt2_124m()``): learned positions,
+  LayerNorm, GELU MLP, tied embeddings — the "GPT-2 124M on OpenWebText"
+  config.
+- **Llama style** (``TransformerConfig.llama2_7b()``): RoPE, RMSNorm,
+  SwiGLU, GQA, untied head — the "Llama-2 7B LoRA fine-tune" config
+  (``lora_rank > 0`` adds adapters; see :mod:`rocket_tpu.models.lora`).
+
+TPU-first design notes:
+
+- every parameter carries logical-axis names (scaling-book recipe: embed on
+  ``fsdp``, heads/mlp/vocab on ``tensor``) so the mesh rules decide between
+  pure DP, ZeRO-style fsdp, tensor parallel, or combinations;
+- activations are sharding-constrained at the residual stream and attention
+  reshapes (``('batch', 'sequence', 'embed')``) — with a non-trivial ``seq``
+  axis this IS sequence parallelism for the norms/MLPs, and attention
+  switches to the ring implementation over the same axis;
+- blocks can be ``remat``-ed (trade FLOPs for HBM) and ``scan``-stacked
+  (one compiled block body instead of ``n_layers`` copies — compile time
+  O(1) in depth, the standard big-model pattern);
+- attention logits accumulate in f32 on the MXU regardless of bf16 compute
+  (``ops.attention``).
+
+Batch contract (blackboard style, reference ``module.py:139``): reads
+``batch['tokens']`` (int32 ``[B, S]``; optional ``positions``,
+``segment_ids``), writes ``batch['logits']``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.models.layers import (
+    Embed,
+    PDense,
+    RMSNorm,
+    apply_rope,
+    rotary_embedding,
+)
+from rocket_tpu.ops.attention import attend
+from rocket_tpu.parallel.context import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden: int = 512
+    n_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None  # None -> n_heads (MHA)
+    ffn_dim: Optional[int] = None  # None -> 4*hidden (gelu) / 8/3*hidden (swiglu)
+    max_seq: int = 2048
+    norm: str = "rmsnorm"  # 'rmsnorm' | 'layernorm'
+    mlp: str = "swiglu"  # 'swiglu' | 'gelu'
+    positions: str = "rope"  # 'rope' | 'learned'
+    rope_theta: float = 10000.0
+    dropout: float = 0.0
+    tie_embeddings: bool = False
+    use_bias: bool = False
+    norm_eps: float = 1e-5
+    attention: str = "auto"  # 'auto' | 'dot' | 'flash' | 'ring'
+    attention_block_q: int = 256
+    attention_block_k: int = 512
+    causal: bool = True  # False -> bidirectional encoder (ViT)
+    remat: bool = False
+    scan_layers: bool = False
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.n_heads
+
+    @property
+    def mlp_dim(self) -> int:
+        if self.ffn_dim:
+            return self.ffn_dim
+        return 4 * self.hidden if self.mlp == "gelu" else int(8 * self.hidden / 3)
+
+    # -- the BASELINE.json ladder -------------------------------------------
+
+    @classmethod
+    def tiny(cls, **kw) -> "TransformerConfig":
+        return cls(
+            vocab_size=256, hidden=64, n_layers=2, n_heads=4, max_seq=128, **kw
+        )
+
+    @classmethod
+    def gpt2_124m(cls, **kw) -> "TransformerConfig":
+        return cls(
+            vocab_size=50257,
+            hidden=768,
+            n_layers=12,
+            n_heads=12,
+            max_seq=1024,
+            norm="layernorm",
+            mlp="gelu",
+            positions="learned",
+            tie_embeddings=True,
+            use_bias=True,
+            **kw,
+        )
+
+    @classmethod
+    def llama2_7b(cls, **kw) -> "TransformerConfig":
+        return cls(
+            vocab_size=32000,
+            hidden=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=32,
+            ffn_dim=11008,
+            max_seq=4096,
+            norm="rmsnorm",
+            mlp="swiglu",
+            positions="rope",
+            norm_eps=1e-5,
+            **kw,
+        )
+
+    @classmethod
+    def llama3_8b(cls, **kw) -> "TransformerConfig":
+        return cls(
+            vocab_size=128256,
+            hidden=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=8,
+            ffn_dim=14336,
+            max_seq=8192,
+            rope_theta=500000.0,
+            **kw,
+        )
+
+
+class _Norm(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        if cfg.norm == "rmsnorm":
+            return RMSNorm(eps=cfg.norm_eps)(x)
+        return nn.LayerNorm(
+            epsilon=cfg.norm_eps,
+            use_bias=cfg.use_bias,
+            scale_init=nn.with_partitioning(nn.initializers.ones_init(), ("norm",)),
+        )(x)
+
+
+class Attention(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids, train: bool):
+        cfg = self.config
+        B, S, _ = x.shape
+        H, KV, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda feat, name: PDense(  # noqa: E731
+            feat,
+            logical_axes=("embed", "heads"),
+            use_bias=cfg.use_bias,
+            lora_rank=cfg.lora_rank,
+            lora_alpha=cfg.lora_alpha,
+            name=name,
+        )
+        q = dense(H * D, "q")(x).reshape(B, S, H, D)
+        k = dense(KV * D, "k")(x).reshape(B, S, KV, D)
+        v = dense(KV * D, "v")(x).reshape(B, S, KV, D)
+        q = constrain(q, "batch", "sequence", "heads", None)
+        k = constrain(k, "batch", "sequence", "heads", None)
+        v = constrain(v, "batch", "sequence", "heads", None)
+        if cfg.positions == "rope":
+            cos, sin = rotary_embedding(positions, D, cfg.rope_theta, x.dtype)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+        out = attend(
+            q,
+            k,
+            v,
+            impl=cfg.attention,
+            causal=cfg.causal,
+            segment_ids=segment_ids,
+            block_q=cfg.attention_block_q,
+            block_k=cfg.attention_block_k,
+        )
+        out = out.reshape(B, S, H * D)
+        out = PDense(
+            cfg.hidden,
+            logical_axes=("heads", "embed"),
+            use_bias=cfg.use_bias,
+            lora_rank=cfg.lora_rank,
+            lora_alpha=cfg.lora_alpha,
+            name="o",
+        )(out)
+        if cfg.dropout and train:
+            out = nn.Dropout(cfg.dropout, deterministic=False)(out)
+        return out
+
+
+class MLP(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, train: bool):
+        cfg = self.config
+        up_axes = ("embed", "mlp")
+        down_axes = ("mlp", "embed")
+        if cfg.mlp == "swiglu":
+            gate = PDense(cfg.mlp_dim, logical_axes=up_axes, name="gate")(x)
+            up = PDense(cfg.mlp_dim, logical_axes=up_axes, name="up")(x)
+            h = nn.silu(gate) * up
+        else:
+            h = nn.gelu(
+                PDense(
+                    cfg.mlp_dim,
+                    logical_axes=up_axes,
+                    use_bias=cfg.use_bias,
+                    name="up",
+                )(x)
+            )
+        h = constrain(h, "batch", "sequence", "mlp")
+        out = PDense(
+            cfg.hidden,
+            logical_axes=down_axes,
+            use_bias=cfg.use_bias,
+            name="down",
+        )(h)
+        if cfg.dropout and train:
+            out = nn.Dropout(cfg.dropout, deterministic=False)(out)
+        return out
+
+
+class Block(nn.Module):
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, segment_ids, train: bool):
+        cfg = self.config
+        x = constrain(x, "batch", "sequence", "act_embed")
+        x = x + Attention(cfg, name="attn")(
+            _Norm(cfg, name="ln1")(x), positions, segment_ids, train
+        )
+        x = x + MLP(cfg, name="mlp")(_Norm(cfg, name="ln2")(x), train)
+        return constrain(x, "batch", "sequence", "act_embed")
+
+
+class TransformerLM(nn.Module):
+    """Batch-rewriting LM (blackboard contract): ``tokens -> logits``."""
+
+    config: TransformerConfig
+    tokens_key: str = "tokens"
+    logits_key: str = "logits"
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        cfg = self.config
+        tokens = batch[self.tokens_key]
+        B, S = tokens.shape
+        given_positions = batch.get("positions") if hasattr(batch, "get") else None
+        positions = given_positions
+        if positions is None:
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        segment_ids = batch.get("segment_ids") if hasattr(batch, "get") else None
+
+        embed = Embed(cfg.vocab_size, cfg.hidden, name="embed")
+        x = embed(tokens)
+        if cfg.positions == "learned":
+            pos_table = self.param(
+                "pos_embedding",
+                nn.with_partitioning(
+                    nn.initializers.normal(0.02), (None, "embed")
+                ),
+                (cfg.max_seq, cfg.hidden),
+            )
+            pos_table = jnp.asarray(pos_table, x.dtype)
+            if given_positions is None:
+                # Contiguous positions: a static slice beats a gather
+                # (gathers from sharded tables trigger SPMD full remat).
+                x = x + pos_table[None, :S, :]
+            else:
+                x = x + pos_table[positions]
+        x = constrain(x, "batch", "sequence", "act_embed")
+        if cfg.dropout and train:
+            x = nn.Dropout(cfg.dropout, deterministic=False)(x)
+
+        block_cls = Block
+        if cfg.remat:
+            block_cls = nn.remat(
+                Block, static_argnums=(4,), prevent_cse=False
+            )
+        if cfg.scan_layers:
+            x, _ = nn.scan(
+                lambda mdl, carry, _: (
+                    mdl(carry, positions, segment_ids, train),
+                    None,
+                ),
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "layers"},
+            )(block_cls(cfg, name="blocks"), x, None)
+        else:
+            for i in range(cfg.n_layers):
+                x = block_cls(cfg, name=f"block_{i}")(
+                    x, positions, segment_ids, train
+                )
+
+        x = _Norm(cfg, name="ln_f")(x)
+        if cfg.tie_embeddings:
+            logits = embed.attend(x)
+        else:
+            logits = PDense(
+                cfg.vocab_size, logical_axes=("embed", "vocab"), name="head"
+            )(x)
+        logits = constrain(logits, "batch", "sequence", "vocab")
+        out = Attributes(batch) if hasattr(batch, "get") else Attributes(batch)
+        out[self.logits_key] = logits
+        return out

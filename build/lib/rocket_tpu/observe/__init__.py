@@ -5,24 +5,21 @@ from rocket_tpu.observe.backends import (
     TrackerBackend,
 )
 from rocket_tpu.utils.logging import RankAwareLogger, get_logger
-from rocket_tpu.observe.meter import Accuracy, Meter, Metric, StatMetric
+from rocket_tpu.observe.meter import Meter, Metric
 from rocket_tpu.observe.profile import Profiler, Throughput, annotate, debug_mode
-from rocket_tpu.observe.tracker import ImageLogger, Tracker
+from rocket_tpu.observe.tracker import Tracker
 
 __all__ = [
     "JsonlBackend",
     "MemoryBackend",
-    "Accuracy",
     "Meter",
     "Metric",
-    "StatMetric",
     "Profiler",
     "Throughput",
     "annotate",
     "debug_mode",
     "RankAwareLogger",
     "TensorBoardBackend",
-    "ImageLogger",
     "Tracker",
     "TrackerBackend",
     "get_logger",

@@ -1,0 +1,117 @@
+"""Tracker backends — pluggable experiment-logging sinks.
+
+Capability parity: reference backends ride accelerate's tracking stack
+(``rocket/core/tracker.py:86-105``: a string name like ``"tensorboard"`` or a
+ready ``GeneralTracker`` instance).  Same contract here: a string resolves via
+:func:`resolve_backend`, or pass any object with the :class:`TrackerBackend`
+methods.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+class TrackerBackend:
+    """Protocol: scalar/image sinks + close."""
+
+    def log_scalars(self, data: Dict[str, Any], step: int) -> None:
+        raise NotImplementedError
+
+    def log_images(self, data: Dict[str, Any], step: int) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class TensorBoardBackend(TrackerBackend):
+    """tensorboardX writer (reference default backend, ``tracker.py:53``)."""
+
+    def __init__(self, logging_dir: str) -> None:
+        from tensorboardX import SummaryWriter
+
+        self._writer = SummaryWriter(logdir=logging_dir)
+
+    def log_scalars(self, data: Dict[str, Any], step: int) -> None:
+        for tag, value in data.items():
+            self._writer.add_scalar(tag, float(value), global_step=step)
+
+    def log_images(self, data: Dict[str, Any], step: int) -> None:
+        for tag, value in data.items():
+            img = np.asarray(value)
+            fmt = "HWC" if img.ndim == 3 and img.shape[-1] in (1, 3, 4) else "CHW"
+            self._writer.add_image(tag, img, global_step=step, dataformats=fmt)
+
+    def close(self) -> None:
+        self._writer.close()
+
+
+class JsonlBackend(TrackerBackend):
+    """Append-only ``metrics.jsonl`` — trivially greppable, no deps."""
+
+    def __init__(self, logging_dir: str, filename: str = "metrics.jsonl") -> None:
+        os.makedirs(logging_dir, exist_ok=True)
+        self._path = os.path.join(logging_dir, filename)
+        self._file = open(self._path, "a")
+
+    def log_scalars(self, data: Dict[str, Any], step: int) -> None:
+        record = {"step": int(step), "time": time.time()}
+        record.update({k: float(v) for k, v in data.items()})
+        self._file.write(json.dumps(record) + "\n")
+        self._file.flush()
+
+    def log_images(self, data: Dict[str, Any], step: int) -> None:
+        pass  # images don't fit jsonl; intentionally dropped
+
+    def close(self) -> None:
+        self._file.close()
+
+
+class MemoryBackend(TrackerBackend):
+    """In-memory sink for tests and programmatic inspection."""
+
+    def __init__(self) -> None:
+        self.scalars: list = []
+        self.images: list = []
+
+    def log_scalars(self, data: Dict[str, Any], step: int) -> None:
+        self.scalars.append((int(step), {k: float(v) for k, v in data.items()}))
+
+    def log_images(self, data: Dict[str, Any], step: int) -> None:
+        self.images.append((int(step), dict(data)))
+
+
+BACKENDS = {
+    "tensorboard": TensorBoardBackend,
+    "jsonl": JsonlBackend,
+    "memory": MemoryBackend,
+}
+
+
+def resolve_backend(
+    backend: Any, logging_dir: Optional[str]
+) -> TrackerBackend:
+    if isinstance(backend, TrackerBackend):
+        return backend
+    if isinstance(backend, str):
+        if backend not in BACKENDS:
+            raise ValueError(
+                f"unknown tracker backend {backend!r}; choose from "
+                f"{sorted(BACKENDS)} or pass a TrackerBackend instance"
+            )
+        cls = BACKENDS[backend]
+        if cls is MemoryBackend:
+            return cls()
+        if logging_dir is None:
+            raise RuntimeError(
+                f"backend {backend!r} needs a project dir — give the "
+                f"Launcher a tag (reference contract, checkpoint.py:75-81)"
+            )
+        return cls(logging_dir)
+    raise TypeError(f"cannot interpret tracker backend {backend!r}")

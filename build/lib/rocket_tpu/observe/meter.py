@@ -1,0 +1,131 @@
+"""Meter / Metric — distributed evaluation metrics.
+
+Capability parity: reference ``rocket/core/meter.py:30-206``:
+
+- ``Meter`` runs **only in eval cycles** (``meter.py:84-85``), gathers the
+  listed batch keys across all ranks (``gather_for_metrics``, ``:93``),
+  rebuilds ``attrs.batch`` with the gathered values (``:96-103``), then
+  dispatches to its child ``Metric`` capsules (``:105``);
+- ``Metric`` is the user-subclassed accumulator: ``set`` pins the step to the
+  epoch (``:142-158``), ``launch`` accumulates, ``reset`` finalizes + clears
+  (``:160-206``; e.g. ``Accuracy`` in ``examples/mnist.py:20-39``).
+
+TPU-first: the gather is :func:`rocket_tpu.parallel.multihost.to_host_global`
+on global jax Arrays, and the duplicate-padding removal that accelerate hides
+inside ``gather_for_metrics`` is explicit here — the data loader marks padded
+rows in the batch's ``_valid`` mask and the Meter drops them before the
+metrics see the data (static batch shapes on device, exact sample counts on
+host; SURVEY §7.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.core.dispatcher import Dispatcher
+from rocket_tpu.parallel.multihost import to_host_global
+
+
+class Metric(Capsule):
+    """Abstract per-cycle metric accumulator (reference
+    ``meter.py:108-206``). Subclass and implement ``launch`` (accumulate from
+    ``attrs.batch``) and ``reset`` (finalize: push to tracker / loop state,
+    clear accumulators)."""
+
+    def __init__(
+        self,
+        statefull: bool = False,
+        priority: int = 1000,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, logger=logger)
+        self._step = 0
+
+    def set(self, attrs: Optional[Attributes] = None) -> None:
+        """Pin the record step to the current epoch (reference
+        ``meter.py:142-158``)."""
+        if attrs is not None and attrs.launcher is not None:
+            self._step = int(attrs.launcher.epoch_idx or 0)
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        raise NotImplementedError
+
+    def reset(self, attrs: Optional[Attributes] = None) -> None:
+        raise NotImplementedError
+
+
+class Meter(Dispatcher):
+    """Gather batch keys globally, then run child metrics on exact
+    (dedup-masked) host arrays.
+
+    Parameters
+    ----------
+    keys:
+        Batch keys to gather (sorted, reference ``meter.py:54-61``).
+    capsules:
+        Child :class:`Metric` instances.
+    mask_key:
+        Valid-row mask published by the data loader (drop padded rows).
+    """
+
+    def __init__(
+        self,
+        keys: Sequence[str],
+        capsules: Iterable[Capsule] = (),
+        mask_key: str = "_valid",
+        statefull: bool = False,
+        priority: int = 1000,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(
+            capsules=capsules, statefull=statefull, priority=priority, logger=logger
+        )
+        self._keys: List[str] = sorted(keys)
+        self._mask_key = mask_key
+
+    def guard(self) -> None:
+        super().guard()
+        for capsule in self._capsules:
+            if not isinstance(capsule, Metric):
+                raise TypeError(
+                    f"Meter children must be Metrics, got "
+                    f"{type(capsule).__name__}"
+                )
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        if attrs is None or attrs.batch is None:
+            return
+        looper = attrs.looper
+        if looper is not None and looper.grad_enabled:
+            return  # eval-only (reference ``meter.py:84-85``)
+        batch = attrs.batch
+        wanted = {}
+        for key in self._keys:
+            value = batch.get(key) if hasattr(batch, "get") else None
+            if value is None:
+                raise KeyError(
+                    f"Meter: key {key!r} missing from batch "
+                    f"(has {sorted(batch) if hasattr(batch, 'keys') else '?'})"
+                )
+            wanted[key] = value
+        mask_value = batch.get(self._mask_key) if hasattr(batch, "get") else None
+        if mask_value is not None:
+            wanted[self._mask_key] = mask_value
+        # ONE host gather for the whole pytree (one DCN collective per
+        # iteration, not one per key).
+        host_tree = to_host_global(wanted)
+        mask = None
+        if mask_value is not None:
+            mask = host_tree.pop(self._mask_key).astype(bool)
+        gathered = Attributes(batch)
+        for key, host in host_tree.items():
+            if mask is not None and np.ndim(host) >= 1 and len(host) == len(mask):
+                host = host[mask]
+            gathered[key] = host
+        attrs.batch = gathered
+        for capsule in self._capsules:
+            capsule.launch(attrs)

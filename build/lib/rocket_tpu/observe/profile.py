@@ -1,0 +1,181 @@
+"""Profiling and throughput instrumentation.
+
+The reference has none (SURVEY §5.1 — its only timing artifact is the tqdm
+bar).  Here:
+
+- :class:`Profiler` — a capsule that captures a ``jax.profiler`` trace
+  (TensorBoard/Perfetto XPlane format) for a window of iterations, skipping
+  warmup so compile time doesn't pollute the trace;
+- :class:`Throughput` — per-iteration wall-clock + samples/sec (EMA),
+  published to the loop status line and the tracker without ever forcing a
+  device sync (wall-clock between launches measures the async dispatch
+  pipeline's steady-state rate, which is the number that matters);
+- :func:`annotate` — named trace spans for pipeline phases.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Any, Optional
+
+import jax
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+
+
+def annotate(name: str):
+    """Named span in the profiler timeline (``jax.profiler.TraceAnnotation``)."""
+    return jax.profiler.TraceAnnotation(name)
+
+
+class Profiler(Capsule):
+    """Capture a profiler trace for iterations ``[start, start+count)`` of
+    the first cycle it runs in.
+
+    Output lands in ``<project>/logs/profile`` (or ``log_dir``) — open with
+    TensorBoard's profile plugin or Perfetto.
+    """
+
+    def __init__(
+        self,
+        start: int = 10,
+        count: int = 5,
+        log_dir: Optional[str] = None,
+        priority: int = 150,  # after compute, before Checkpointer
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(statefull=False, priority=priority, logger=logger)
+        self._start = start
+        self._count = count
+        self._log_dir = log_dir
+        self._iter = 0
+        self._active = False
+        self._done = False
+
+    def setup(self, attrs: Optional[Attributes] = None) -> None:
+        super().setup(attrs)
+        if self._log_dir is None:
+            base = self._runtime.logging_dir or "."
+            self._log_dir = os.path.join(base, "profile")
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        if self._done:
+            return
+        if not self._active and self._iter == self._start:
+            if self._runtime is None or self._runtime.is_main_process:
+                jax.profiler.start_trace(self._log_dir)
+                self._active = True
+                self._logger.info("profiler trace started -> %s", self._log_dir)
+        elif self._active and self._iter >= self._start + self._count:
+            self._stop()
+        self._iter += 1
+
+    def _stop(self) -> None:
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False
+            self._done = True
+            self._logger.info("profiler trace written -> %s", self._log_dir)
+
+    def reset(self, attrs: Optional[Attributes] = None) -> None:
+        self._stop()
+
+    def destroy(self, attrs: Optional[Attributes] = None) -> None:
+        self._stop()
+        super().destroy(attrs)
+
+
+class Throughput(Capsule):
+    """samples/sec + step wall-clock, EMA-smoothed, on the status line and
+    tracker. Reads the batch's leading dim (global batch) from ``attrs.batch``.
+    """
+
+    def __init__(
+        self,
+        ema: float = 0.9,
+        tag: str = "throughput",
+        log_every: int = 50,
+        priority: int = 300,  # after Module, before Tracker flush
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(statefull=False, priority=priority, logger=logger)
+        self._ema_factor = ema
+        self._tag = tag
+        self._log_every = log_every
+        self._last_time: Optional[float] = None
+        self._ema: Optional[float] = None
+        self._iter = 0
+
+    def set(self, attrs: Optional[Attributes] = None) -> None:
+        self._last_time = None
+        self._ema = None
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            return
+        dt = now - self._last_time
+        self._last_time = now
+        batch = attrs.batch if attrs is not None else None
+        size = _batch_size(batch)
+        rate = size / dt if dt > 0 else 0.0
+        self._ema = (
+            rate
+            if self._ema is None
+            else self._ema_factor * self._ema + (1 - self._ema_factor) * rate
+        )
+        self._iter += 1
+        if attrs is None:
+            return
+        looper = attrs.looper
+        if looper is not None and looper.state is not None:
+            looper.state[self._tag] = f"{self._ema:,.0f}/s"
+        if (
+            attrs.tracker is not None
+            and self._iter % self._log_every == 0
+        ):
+            attrs.tracker.scalars.append(
+                Attributes(
+                    step=self._iter,
+                    data={
+                        f"{self._tag}/samples_per_sec": self._ema,
+                        f"{self._tag}/step_ms": dt * 1e3,
+                    },
+                )
+            )
+
+
+def _batch_size(batch: Any) -> int:
+    if batch is None:
+        return 0
+    leaves = jax.tree_util.tree_leaves(batch)
+    for leaf in leaves:
+        shape = getattr(leaf, "shape", None)
+        if shape:
+            return int(shape[0])
+    return 0
+
+
+@contextlib.contextmanager
+def debug_mode(
+    nans: bool = True,
+    disable_jit: bool = False,
+):
+    """SURVEY §5.2 debug aid: NaN/Inf checking and optionally eager
+    execution.  Use around ``launcher.launch()`` when hunting numerical or
+    tracing bugs; combine with ``multihost.assert_equal`` for cross-host
+    divergence checks."""
+    stack = contextlib.ExitStack()
+    if nans:
+        jax.config.update("jax_debug_nans", True)
+        stack.callback(lambda: jax.config.update("jax_debug_nans", False))
+    if disable_jit:
+        stack.enter_context(jax.disable_jit())
+    try:
+        yield
+    finally:
+        stack.close()

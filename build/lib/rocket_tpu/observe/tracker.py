@@ -102,61 +102,3 @@ class Tracker(Capsule):
             self._backend.log_scalars(dict(record.data), int(record.step))
         for record in images:
             self._backend.log_images(dict(record.data), int(record.step))
-
-
-class ImageLogger(Capsule):
-    """Pushes sample images from the batch through the tracker's image
-    channel (the producer side of reference ``tracker.py:246-254``).
-
-    Mount it next to the model in a looper; every ``log_every`` iterations it
-    takes the first ``max_images`` rows of ``batch[key]`` (NHWC, one device
-    transfer) and appends an image record the Tracker flushes to its backend
-    (tensorboard renders them; jsonl drops them).
-    """
-
-    def __init__(
-        self,
-        key: str = "image",
-        tag: Optional[str] = None,
-        max_images: int = 4,
-        log_every: int = 100,
-        statefull: bool = False,
-        priority: int = 300,  # after compute (1000), before Tracker (200)
-        logger: Optional[Any] = None,
-    ) -> None:
-        super().__init__(statefull=statefull, priority=priority, logger=logger)
-        self._key = key
-        self._tag = tag or f"images/{key}"
-        self._max_images = int(max_images)
-        self._log_every = int(log_every)
-        self._iter_idx = 0
-        self._global_iter = 0  # step for the records: never resets, so
-        # TensorBoard keeps every sample instead of last-write-wins per epoch
-
-    def set(self, attrs: Optional[Attributes] = None) -> None:
-        self._iter_idx = 0
-
-    def launch(self, attrs: Optional[Attributes] = None) -> None:
-        if attrs is None or attrs.tracker is None or attrs.batch is None:
-            return
-        idx, self._iter_idx = self._iter_idx, self._iter_idx + 1
-        step, self._global_iter = self._global_iter, self._global_iter + 1
-        if idx % self._log_every != 0:
-            return
-        batch = attrs.batch
-        value = batch.get(self._key) if hasattr(batch, "get") else None
-        if value is None:
-            return
-        # Multi-host safe: the slice of a host-sharded global batch isn't
-        # fully addressable — to_host_global reassembles it on every host.
-        from rocket_tpu.parallel.multihost import to_host_global
-
-        images = to_host_global(value[: self._max_images])
-        attrs.tracker.images.append(
-            Attributes(
-                step=step,
-                data={
-                    f"{self._tag}/{i}": images[i] for i in range(len(images))
-                },
-            )
-        )

@@ -1,0 +1,5 @@
+from rocket_tpu.ops.attention import attend, dot_attention
+from rocket_tpu.ops.flash import flash_attention
+from rocket_tpu.ops.ring import ring_attention
+
+__all__ = ["attend", "dot_attention", "flash_attention", "ring_attention"]

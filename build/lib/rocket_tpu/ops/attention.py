@@ -1,0 +1,125 @@
+"""Attention implementations — the framework's hot op.
+
+The reference contains no attention at all (models are user-supplied,
+SURVEY §5.7); the TPU build makes long-context attention a first-class op
+with three interchangeable implementations behind one signature:
+
+- ``dot``   — plain einsum softmax attention (XLA-fused; baseline and the
+  correctness oracle for the others).
+- ``flash`` — blocked online-softmax Pallas TPU kernel
+  (:mod:`rocket_tpu.ops.flash`): O(S) memory, MXU-tiled.
+- ``ring``  — blockwise ring attention over the mesh's ``seq`` axis
+  (:mod:`rocket_tpu.ops.ring`): sequence/context parallelism for sequences
+  too long for one chip, K/V blocks rotating over ICI via ``ppermute``.
+
+All take ``(q, k, v)`` shaped ``[batch, seq, heads, head_dim]`` (K/V may
+have fewer heads — grouped-query attention is handled by head repetition
+inside each impl).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _repeat_kv(k: Array, v: Array, num_q_heads: int):
+    """Expand grouped K/V heads to match Q heads (GQA/MQA)."""
+    kv_heads = k.shape[2]
+    if kv_heads == num_q_heads:
+        return k, v
+    if num_q_heads % kv_heads != 0:
+        raise ValueError(f"q heads {num_q_heads} not a multiple of kv heads {kv_heads}")
+    reps = num_q_heads // kv_heads
+    k = jnp.repeat(k, reps, axis=2)
+    v = jnp.repeat(v, reps, axis=2)
+    return k, v
+
+
+def dot_attention(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    causal: bool = True,
+    segment_ids: Optional[Array] = None,
+    scale: Optional[float] = None,
+) -> Array:
+    """Reference einsum attention. Computes logits in f32 for stability
+    regardless of the compute dtype (bf16 inputs stay bf16 on the matmuls —
+    MXU native — with an f32 softmax accumulator, XLA's preferred pattern).
+    """
+    B, S, H, D = q.shape
+    k, v = _repeat_kv(k, v, H)
+    scale = scale if scale is not None else D ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    logits = logits * scale
+    if causal:
+        q_pos = jnp.arange(S)[:, None]
+        k_pos = jnp.arange(k.shape[1])[None, :]
+        mask = q_pos >= k_pos
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        logits = jnp.where(seg_mask[:, None], logits, -jnp.inf)
+    weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
+
+
+def attend(
+    q: Array,
+    k: Array,
+    v: Array,
+    *,
+    impl: str = "auto",
+    causal: bool = True,
+    segment_ids: Optional[Array] = None,
+    scale: Optional[float] = None,
+    seq_axis: Optional[str] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+) -> Array:
+    """Dispatch to an attention implementation.
+
+    ``impl='auto'``: flash on TPU (falls back to dot where the kernel's
+    tiling constraints aren't met), dot elsewhere. ``impl='ring'`` requires
+    an active mesh context with a non-trivial ``seq`` axis.
+    """
+    if impl == "auto":
+        impl = "flash" if q.shape[1] >= 128 and _on_tpu() else "dot"
+    if impl == "dot":
+        return dot_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
+        )
+    if impl == "flash":
+        from rocket_tpu.ops.flash import flash_attention
+
+        return flash_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
+            block_q=block_q, block_k=block_k,
+        )
+    if impl == "ring":
+        if segment_ids is not None:
+            raise ValueError(
+                "attend(impl='ring') does not support segment_ids yet: the "
+                "ring schedule has no segment masking, so packed batches "
+                "would silently attend across document boundaries. Use "
+                "impl='flash' or 'dot' for packed sequences."
+            )
+        from rocket_tpu.ops.ring import ring_attention
+
+        return ring_attention(
+            q, k, v, causal=causal, scale=scale, seq_axis=seq_axis or "seq"
+        )
+    raise ValueError(f"unknown attention impl {impl!r}")
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except RuntimeError:  # pragma: no cover
+        return False

@@ -1,0 +1,137 @@
+"""Ring attention — sequence/context parallelism over the mesh ``seq`` axis.
+
+For sequences too long for one chip's HBM, Q/K/V are sharded along the
+sequence dimension across the ``seq`` mesh axis.  Each device computes
+blockwise attention against its local K/V chunk while the K/V chunks rotate
+around the ring via ``lax.ppermute`` (ICI neighbor exchange); a running
+online-softmax (max/normalizer/accumulator) merges the blocks, so after
+``n_seq`` steps every query has attended to the full global sequence —
+attention memory stays O(S/n) per device and the rotation overlaps with
+compute (XLA pipelines the ppermute against the block matmuls).
+
+This is the manual-collective path of the framework (``shard_map`` +
+``ppermute`` over ICI) — the reference's only collectives were NCCL
+all-reduces hidden inside DDP (SURVEY §5.8); long-context parallelism has
+no reference analogue and is TPU-native by construction.
+
+Causality with a rotating ring: every (q_chunk, k_chunk) pair is globally
+positioned, so blocks strictly above the diagonal are masked; the masking
+uses a large negative constant and an explicit zero-mask on the
+probabilities (``exp(MASK - MASK) == 1`` would otherwise poison fully-masked
+rows).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from rocket_tpu.parallel.mesh import DATA_AXES
+
+MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _local_block(q, k, v, q_start, k_start, scale, causal):
+    """One (q_chunk x k_chunk) online-softmax block.
+
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; returns (s_max, p_sum, pv) pieces
+    used by the ring merge. Positions are global offsets for causal masking.
+    """
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        Sq, Sk = q.shape[1], k.shape[1]
+        q_pos = q_start + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
+        k_pos = k_start + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
+        mask = (q_pos >= k_pos)[None, None]
+        s = jnp.where(mask, s, MASK_VALUE)
+        return s, mask
+    return s, None
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    scale: Optional[float] = None,
+    seq_axis: str = "seq",
+) -> jax.Array:
+    """Ring attention on ``[B, S, H, D]`` inputs sharded over ``seq_axis``.
+
+    Must be called under a mesh context (the Module opens one around apply);
+    degrades to plain dot attention when the ``seq`` axis is trivial.
+    """
+    from rocket_tpu.ops.attention import _repeat_kv, dot_attention
+    from rocket_tpu.parallel.context import current_mesh
+
+    B, S, H, D = q.shape
+    scale = scale if scale is not None else D ** -0.5
+    mesh = current_mesh()
+    if mesh is None or mesh.shape.get(seq_axis, 1) == 1:
+        return dot_attention(q, k, v, causal=causal, scale=scale)
+    k, v = _repeat_kv(k, v, H)
+    n = mesh.shape[seq_axis]
+
+    spec = P(DATA_AXES, seq_axis, None, None)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_vma=False,
+    )
+    def ring(ql, kl, vl):
+        # ql/kl/vl: local chunks [b, S/n, H, D]
+        chunk = ql.shape[1]
+        my = lax.axis_index(seq_axis)
+        q_start = my * chunk
+        perm = [(i, (i + 1) % n) for i in range(n)]
+
+        def step(i, carry):
+            acc, m, l, k_cur, v_cur = carry
+            src = (my - i) % n  # whose chunk we currently hold
+            s, mask = _local_block(
+                ql, k_cur, v_cur, q_start, src * chunk, scale, causal
+            )
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new)
+            if mask is not None:
+                p = jnp.where(mask, p, 0.0)
+            correction = jnp.exp(m - m_new)  # [b, H, Sq, 1]
+            l = correction * l + jnp.sum(p, axis=-1, keepdims=True)
+            pv = jnp.einsum(
+                "bhqk,bkhd->bqhd", p, v_cur,
+                preferred_element_type=jnp.float32,
+            )
+            acc = acc * correction.transpose(0, 2, 1, 3) + pv
+            # rotate K/V to the next device; skipped on the last step
+            k_nxt, v_nxt = lax.cond(
+                i < n - 1,
+                lambda kv: tuple(
+                    lax.ppermute(x, seq_axis, perm) for x in kv
+                ),
+                lambda kv: kv,
+                (k_cur, v_cur),
+            )
+            return acc, m_new, l, k_nxt, v_nxt
+
+        b, sq = ql.shape[0], ql.shape[1]
+        acc0 = jnp.zeros((b, sq, H, D), jnp.float32)
+        m0 = jnp.full((b, H, sq, 1), MASK_VALUE, jnp.float32)
+        l0 = jnp.zeros((b, H, sq, 1), jnp.float32)
+        acc, m, l, _, _ = lax.fori_loop(
+            0, n, step, (acc0, m0, l0, kl, vl)
+        )
+        safe_l = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1, 3)
+        return (acc / safe_l).astype(ql.dtype)
+
+    return ring(q, k, v)

@@ -1,0 +1,34 @@
+from rocket_tpu.parallel.mesh import (
+    AXIS_NAMES,
+    DATA_AXES,
+    MeshSpec,
+    data_parallel_mesh,
+    single_device_mesh,
+)
+from rocket_tpu.parallel.sharding import (
+    DEFAULT_RULES,
+    P,
+    ShardingRules,
+    batch_sharding,
+    named_sharding,
+    replicated,
+    tree_shardings,
+)
+from rocket_tpu.parallel import collectives, multihost
+
+__all__ = [
+    "AXIS_NAMES",
+    "DATA_AXES",
+    "MeshSpec",
+    "data_parallel_mesh",
+    "single_device_mesh",
+    "DEFAULT_RULES",
+    "P",
+    "ShardingRules",
+    "batch_sharding",
+    "named_sharding",
+    "replicated",
+    "tree_shardings",
+    "collectives",
+    "multihost",
+]

@@ -1,0 +1,94 @@
+"""Device mesh construction — the parallelism substrate.
+
+The reference has no mesh concept: its only strategy is DDP data-parallel via
+accelerate/NCCL (``rocket/core/module.py:106``, SURVEY §2.2).  The TPU build
+makes the mesh explicit and first-class: every run owns a
+:class:`jax.sharding.Mesh` with six named axes
+
+    ``('data', 'pipe', 'fsdp', 'expert', 'seq', 'tensor')``
+
+covering data / pipeline / ZeRO-style parameter / expert (MoE) / sequence
+(ring) / tensor parallelism.  Axes of size 1 cost nothing, so a single spec
+type degrades gracefully from a v4-32 GSPMD run to one CPU device — the
+"MNIST stays CPU-runnable" requirement (SURVEY §7.4).
+
+Axis order is chosen for ICI locality: ``tensor`` (highest-bandwidth, most
+latency-sensitive collectives) is innermost so its groups map to physically
+adjacent chips; ``data`` (lowest-frequency gradient psum) is outermost and may
+ride DCN across slices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+# Canonical axis names, outermost to innermost.
+AXIS_NAMES: Tuple[str, ...] = ("data", "pipe", "fsdp", "expert", "seq", "tensor")
+
+DATA_AXES: Tuple[str, ...] = ("data", "fsdp")  # batch dim shards over these
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Declarative mesh shape. ``-1`` on exactly one axis means "fill with the
+    remaining devices" (default: ``data``)."""
+
+    data: int = -1
+    pipe: int = 1
+    fsdp: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def sizes(self, num_devices: int) -> Tuple[int, ...]:
+        raw = [self.data, self.pipe, self.fsdp, self.expert, self.seq, self.tensor]
+        fills = [i for i, s in enumerate(raw) if s == -1]
+        if len(fills) > 1:
+            raise ValueError(f"MeshSpec: at most one -1 axis, got {raw}")
+        fixed = math.prod(s for s in raw if s != -1)
+        if fills:
+            if num_devices % fixed != 0:
+                raise ValueError(
+                    f"MeshSpec {raw}: fixed axes product {fixed} does not "
+                    f"divide device count {num_devices}"
+                )
+            raw[fills[0]] = num_devices // fixed
+        elif fixed != num_devices:
+            raise ValueError(
+                f"MeshSpec {raw}: product {fixed} != device count {num_devices}"
+            )
+        return tuple(raw)
+
+    def build(self, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+        devices = list(devices) if devices is not None else jax.devices()
+        shape = self.sizes(len(devices))
+        if len(devices) == 1:
+            device_array = np.asarray(devices).reshape(shape)
+        else:
+            try:
+                device_array = mesh_utils.create_device_mesh(
+                    shape, devices=devices
+                )
+            except (ValueError, AssertionError):
+                # Topology-aware layout unavailable (e.g. CPU fake devices)
+                device_array = np.asarray(devices).reshape(shape)
+        return Mesh(device_array, AXIS_NAMES)
+
+
+def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:
+    """A trivial 1-device mesh — lets all sharded code paths run unmodified
+    on one chip or CPU."""
+    device = device or jax.devices()[0]
+    return MeshSpec(data=1).build([device])
+
+
+def data_parallel_mesh(devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """All devices on the ``data`` axis — the reference's DDP topology."""
+    return MeshSpec().build(devices)

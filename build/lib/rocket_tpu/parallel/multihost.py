@@ -1,0 +1,259 @@
+"""Host-level coordination (DCN) — process launch, rendezvous, object sync.
+
+Replaces the reference's accelerate/c10d host-side surface: process-group
+init (implicit in ``Accelerator()``, ``launcher.py:185``),
+``broadcast_object_list`` (``launcher.py:150,161``), the mkdir barrier
+(``launcher.py:156-161``), and ``PartialState().destroy_process_group()``
+(``launcher.py:289-291``).
+
+On TPU pods there is one process per host; ICI collectives are compiled by
+XLA, while everything here rides DCN via ``jax.distributed``.  Every function
+degrades to a no-op/identity in single-process runs so the same pipeline code
+is CPU-runnable.
+"""
+
+from __future__ import annotations
+
+import functools as _functools
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+
+_initialized = False
+_degraded = False  # pod detected but rendezvous skipped (backends existed)
+
+
+def _in_pod_environment() -> bool:
+    """True when this process runs under a MULTI-host accelerator runtime
+    whose coordination parameters jax can auto-detect: a Cloud TPU pod VM
+    (>1 workers), multislice, or SLURM/OpenMPI with >1 tasks.  These are the
+    environments where ``jax.distributed.initialize()`` with no arguments
+    resolves coordinator/process_id itself.  Single-worker variants of the
+    same markers (a lone TPU VM sets ``TPU_WORKER_HOSTNAMES=localhost``) are
+    NOT pods — rendezvous there is pointless and, after backends exist,
+    fatal."""
+    import os
+
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h.strip()]) > 1:
+        return True
+    if "MEGASCALE_COORDINATOR_ADDRESS" in os.environ:
+        return True  # multislice is multi-host by definition
+    for count_var in ("SLURM_NTASKS", "OMPI_COMM_WORLD_SIZE"):
+        try:
+            if int(os.environ.get(count_var, "1")) > 1:
+                return True
+        except ValueError:
+            pass
+    return False
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Bring up the multi-host runtime (idempotent; no-op for single-process
+    runs).  Must be called before the first JAX computation — it therefore
+    performs NO jax calls itself before ``jax.distributed.initialize``.
+
+    Resolution order:
+
+    1. explicit ``coordinator_address`` argument (or
+       ``JAX_COORDINATOR_ADDRESS`` env) → ``jax.distributed.initialize``
+       with explicit parameters;
+    2. a detected pod environment (TPU VM / GKE / SLURM / MPI) →
+       ``jax.distributed.initialize()`` with **no** arguments, letting jax
+       auto-detect coordinator, process count and id;
+    3. otherwise: single-process run, no-op.
+
+    Orbax **async** checkpointing on multi-host runs depends on the
+    distributed KV store this call creates — skipping it would silently
+    de-coordinate async saves (every host must reach the same commit
+    barrier).  The Launcher calls this at setup; call it earlier yourself
+    if you need collectives before ``launch()``.
+
+    Reference analogue: process-group init inside ``Accelerator()``
+    (``launcher.py:185-193``) / ``notebook_launcher`` (``launcher.py:239``).
+    """
+    global _initialized
+    if _initialized:
+        return
+    import os
+
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if coordinator_address is None and not _in_pod_environment():
+        return  # single-process run
+    # Honor every explicitly-given parameter; jax auto-detects the rest.
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as err:
+        text = str(err)
+        if "already initialized" in text or "only be called once" in text:
+            pass  # someone (user code/runtime) beat us to it — fine
+        elif "must be called before" in text and "coordinator_address" not in kwargs:
+            # Auto-detect path, but jax backends already exist (e.g. a
+            # notebook that touched devices first).  Degrade: keep running
+            # single-process rather than kill the run; async multi-host
+            # checkpointing will not be coordinated.  _degraded marks this
+            # so the call stays idempotent and shutdown() stays a no-op.
+            import warnings
+
+            warnings.warn(
+                "multihost.initialize(): pod environment detected but JAX "
+                "backends are already initialized — skipping rendezvous. "
+                "Call rocket_tpu.parallel.multihost.initialize() before any "
+                "jax.devices()/computation for multi-host coordination."
+            )
+            global _degraded
+            _degraded = True
+            _initialized = True
+            return
+        else:
+            raise
+    _initialized = True
+
+
+def shutdown() -> None:
+    """Tear down the multi-host runtime (reference ``launcher.py:289-291``)."""
+    global _initialized, _degraded
+    if _initialized and not _degraded:
+        jax.distributed.shutdown()
+    _initialized = False
+    _degraded = False
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    return jax.process_index() == 0
+
+
+def sync_global_devices(name: str) -> None:
+    """Barrier across all hosts (reference mkdir barrier,
+    ``launcher.py:159-161``)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def broadcast_one_to_all(value: Any, is_source: Optional[bool] = None) -> Any:
+    """Broadcast a pytree of arrays from host 0 to all hosts
+    (reference ``broadcast_object_list``, ``launcher.py:150``)."""
+    if jax.process_count() == 1:
+        return value
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(value, is_source=is_source)
+
+
+def broadcast_object(obj: Any, is_source: Optional[bool] = None) -> Any:
+    """Broadcast an arbitrary picklable python object from host 0 — the
+    project-dir sync path (``launcher.py:125-150``).  Encoded as a padded
+    uint8 buffer over :func:`broadcast_one_to_all`."""
+    if jax.process_count() == 1:
+        return obj
+    if is_source is None:
+        is_source = is_main_process()
+    payload = pickle.dumps(obj) if is_source else b""
+    # Fixed-size header exchange: first broadcast length, then the buffer.
+    length = np.asarray(len(payload), dtype=np.int64)
+    length = int(broadcast_one_to_all(length, is_source=is_source))
+    buf = np.zeros(length, dtype=np.uint8)
+    if is_source:
+        buf[:] = np.frombuffer(payload, dtype=np.uint8)
+    buf = broadcast_one_to_all(buf, is_source=is_source)
+    return pickle.loads(buf.tobytes())
+
+
+def process_allgather(value: Any, tiled: bool = True) -> Any:
+    """Gather a per-host pytree onto every host (reference
+    ``gather_for_metrics`` transport, ``meter.py:93``; padding dedup is done
+    by the caller via valid-masks — see rocket_tpu.observe.meter)."""
+    if jax.process_count() == 1:
+        return jax.tree_util.tree_map(np.asarray, value)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(value, tiled=tiled)
+
+
+def assert_equal(value: Any, fail_message: str = "") -> None:
+    """Debug-mode cross-host agreement check (SURVEY §5.2): asserts all hosts
+    hold identical values (step counters, dir names, termination votes)."""
+    if jax.process_count() == 1:
+        return
+    from jax.experimental import multihost_utils
+
+    multihost_utils.assert_equal(value, fail_message)
+
+
+@_functools.lru_cache(maxsize=64)
+def _replicate_fn(out_shardings: tuple):
+    # One stable jitted identity per sharding signature: a fresh lambda per
+    # call would miss jax's function-keyed executable cache and recompile on
+    # every eval iteration.
+    return jax.jit(lambda *xs: xs, out_shardings=out_shardings)
+
+
+def _replicate_on_mesh(leaves: list) -> list:
+    """All-gather arbitrarily-sharded global arrays to full replication.
+
+    A jitted identity with replicated ``out_shardings`` makes XLA insert the
+    all-gathers (ICI within a slice, DCN across) for the WHOLE tree in one
+    compiled program; the result is fully addressable on every host.  This
+    handles any ``PartitionSpec`` — including leaves sharded along non-leading
+    dims (e.g. logits on the tensor axis), which a per-shard row concat
+    cannot reassemble correctly."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    out_sh = tuple(
+        NamedSharding(leaf.sharding.mesh, PartitionSpec()) for leaf in leaves
+    )
+    replicated = _replicate_fn(out_sh)(*leaves)
+    return [np.asarray(leaf) for leaf in replicated]
+
+
+def to_host_global(value: Any) -> Any:
+    """Materialize a pytree of (possibly mesh-sharded) arrays as full
+    host-side numpy arrays on every process — the transport half of the
+    reference's ``gather_for_metrics`` (``meter.py:93``); padding dedup is
+    the caller's valid-mask job (SURVEY §7.4).
+
+    Fully-addressable arrays (single host, or replicated outputs) are just
+    device_get; cross-host sharded leaves are replicated over the mesh in ONE
+    compiled collective program for the whole tree.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(value)
+    out = [None] * len(leaves)
+    pending = {}  # leaf position -> global sharded array
+    for i, leaf in enumerate(leaves):
+        if not hasattr(leaf, "addressable_shards") or getattr(
+            leaf, "is_fully_addressable", True
+        ):
+            out[i] = np.asarray(leaf)
+        else:
+            pending[i] = leaf
+    if pending:
+        gathered = _replicate_on_mesh(list(pending.values()))
+        for pos, host_global in zip(pending.keys(), gathered):
+            out[pos] = host_global
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -1,0 +1,133 @@
+"""Named-sharding helpers and logical-axis rules.
+
+This is where the reference's implicit "replicate the model, shard the batch"
+DDP contract (``rocket/core/module.py:106``, ``dataset.py:175-180``) becomes
+explicit, composable GSPMD shardings.  Models annotate parameters with
+*logical* axis names (``'embed'``, ``'mlp'``, ``'heads'``, …); a
+:class:`ShardingRules` table maps logical names to mesh axes, so the same
+model code runs replicated on one chip or tensor/fsdp-sharded on a pod —
+only the rules change.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from rocket_tpu.parallel.mesh import DATA_AXES
+
+P = PartitionSpec
+
+MeshAxes = Union[None, str, Tuple[str, ...]]
+
+
+def named_sharding(mesh: Mesh, *spec: MeshAxes) -> NamedSharding:
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(
+    mesh: Mesh, ndim: int = 1, seq_dim: Optional[int] = None
+) -> NamedSharding:
+    """Sharding for a batch of rank ``ndim``: leading dim over the data axes
+    (``data`` × ``fsdp``), optional sequence dim over ``seq`` (for
+    sequence/context parallelism), rest replicated."""
+    spec: list = [DATA_AXES] + [None] * (ndim - 1)
+    if seq_dim is not None:
+        if not -ndim <= seq_dim < ndim:
+            raise ValueError(f"seq_dim {seq_dim} out of range for rank {ndim}")
+        seq_dim = seq_dim % ndim
+        if seq_dim == 0:
+            raise ValueError("seq_dim must not be the batch dim")
+        spec[seq_dim] = "seq"
+    return NamedSharding(mesh, P(*spec))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingRules:
+    """Logical-axis-name → mesh-axis mapping.
+
+    Defaults implement the standard transformer recipe (scaling-book):
+    batch over data axes, embed/residual sharded over ``fsdp`` (ZeRO-style),
+    heads/mlp over ``tensor``, sequence over ``seq``, experts over
+    ``expert``, pipeline stages over ``pipe``.
+    """
+
+    rules: Tuple[Tuple[str, MeshAxes], ...] = (
+        ("batch", DATA_AXES),
+        ("sequence", "seq"),
+        ("embed", "fsdp"),
+        ("heads", "tensor"),
+        ("kv", None),
+        ("mlp", "tensor"),
+        ("vocab", "tensor"),
+        ("expert", "expert"),
+        ("stage", "pipe"),
+        ("norm", None),
+        ("layers", None),  # scan-stacked layer dim (never sharded)
+        # Activation-only axes: the residual stream's feature dim must NOT
+        # reuse the parameter 'embed' -> 'fsdp' mapping (the batch dim
+        # already occupies 'fsdp'; ZeRO shards params, not activations).
+        ("act_embed", None),
+    )
+
+    def table(self) -> Dict[str, MeshAxes]:
+        return dict(self.rules)
+
+    def spec(self, *logical_axes: Optional[str]) -> PartitionSpec:
+        """Translate logical axis names to a PartitionSpec."""
+        table = self.table()
+        out = []
+        for name in logical_axes:
+            if name is None:
+                out.append(None)
+            elif name in table:
+                out.append(table[name])
+            else:
+                raise KeyError(f"unknown logical axis {name!r}; add a rule")
+        return P(*out)
+
+    def sharding(self, mesh: Mesh, *logical_axes: Optional[str]) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(*logical_axes))
+
+    def replace(self, **updates: MeshAxes) -> "ShardingRules":
+        table = self.table()
+        table.update(updates)
+        return ShardingRules(rules=tuple(table.items()))
+
+
+DEFAULT_RULES = ShardingRules()
+
+
+def tree_shardings(mesh: Mesh, tree: Any, rules: ShardingRules = DEFAULT_RULES) -> Any:
+    """Map a pytree of logical-axis tuples (as produced by
+    ``nn.with_partitioning`` metadata / ``nn.get_partition_spec``) to a pytree
+    of NamedShardings."""
+
+    def leaf_to_sharding(leaf: Any) -> Any:
+        if isinstance(leaf, PartitionSpec):
+            return NamedSharding(mesh, leaf)
+        if leaf is None:
+            return replicated(mesh)
+        if isinstance(leaf, (tuple, list)):
+            return rules.sharding(mesh, *leaf)
+        raise TypeError(f"cannot interpret sharding annotation {leaf!r}")
+
+    return jax.tree_util.tree_map(
+        leaf_to_sharding,
+        tree,
+        is_leaf=lambda x: x is None
+        or isinstance(x, (tuple, list, PartitionSpec)),
+    )
+
+
+def shard_like(tree: Any, shardings: Any) -> Any:
+    """Constrain/lay out every leaf of ``tree`` per ``shardings``
+    (device_put for concrete arrays)."""
+    return jax.device_put(tree, shardings)

@@ -1,0 +1,4 @@
+from rocket_tpu.persist.checkpoint import Checkpointer
+from rocket_tpu.persist.orbax_io import CheckpointIO, default_io
+
+__all__ = ["Checkpointer", "CheckpointIO", "default_io"]

@@ -23,28 +23,11 @@ from __future__ import annotations
 
 import os
 import shutil
-import signal
-import threading
 from typing import Any, Optional
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
 from rocket_tpu.persist.orbax_io import default_io
-
-# Set by the SIGTERM handler; checked at every iteration boundary.  TPU pod
-# preemptions deliver SIGTERM with a grace window — the standard recovery
-# path on TPU (SURVEY §5.3).
-_preempted = threading.Event()
-
-
-def _on_sigterm(signum, frame):  # pragma: no cover - exercised via raise_signal
-    _preempted.set()
-    prev = _PREV_HANDLER.get("handler")
-    if callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN):
-        prev(signum, frame)
-
-
-_PREV_HANDLER: dict = {}
 
 
 class Checkpointer(Capsule):
@@ -54,7 +37,6 @@ class Checkpointer(Capsule):
         output_dir_format: str = "weights/{:06d}",
         keep_last: Optional[int] = None,
         save_on_cycle_end: bool = False,
-        save_on_preemption: bool = True,
         statefull: bool = True,
         priority: int = 100,
         logger: Optional[Any] = None,
@@ -66,8 +48,6 @@ class Checkpointer(Capsule):
         self._format = output_dir_format
         self._keep_last = keep_last
         self._save_on_cycle_end = save_on_cycle_end
-        self._save_on_preemption = save_on_preemption
-        self._installed_handler = False
         self._iter_idx = 0
         self._saved_dirs: list = []
 
@@ -93,17 +73,6 @@ class Checkpointer(Capsule):
             if prior_root is not None and prior_root != self._runtime.project_dir:
                 self._saved_dirs += self._snapshots_under(prior_root)
         self._saved_dirs += self._snapshots_under(self._runtime.project_dir)
-        if (
-            self._save_on_preemption
-            and threading.current_thread() is threading.main_thread()
-            and signal.getsignal(signal.SIGTERM) is not _on_sigterm
-        ):
-            # First Checkpointer in the process installs (and later restores)
-            # the handler; further instances share it — re-installing would
-            # make _on_sigterm its own "previous handler" and recurse.
-            _PREV_HANDLER["handler"] = signal.getsignal(signal.SIGTERM)
-            signal.signal(signal.SIGTERM, _on_sigterm)
-            self._installed_handler = True
 
     def _format_parts(self):
         import re
@@ -153,20 +122,6 @@ class Checkpointer(Capsule):
     # -- cycle ---------------------------------------------------------------
 
     def launch(self, attrs: Optional[Attributes] = None) -> None:
-        if _preempted.is_set():
-            # Preemption (SIGTERM): snapshot NOW, make it durable, and vote
-            # to terminate the loop so the process exits inside the grace
-            # window with a clean resumable checkpoint (SURVEY §5.3).
-            _preempted.clear()
-            self._logger.warning(
-                "SIGTERM received — writing preemption checkpoint"
-            )
-            self.save()
-            default_io().wait()
-            self._iter_idx += 1
-            if attrs is not None and attrs.looper is not None:
-                attrs.looper.terminate = True
-            return
         # (idx + 1) cadence: first save after save_every iterations, not a
         # useless step-0 snapshot (reference checkpoint.py:116-120 semantics).
         if (self._iter_idx + 1) % self._save_every == 0:
@@ -179,11 +134,6 @@ class Checkpointer(Capsule):
 
     def destroy(self, attrs: Optional[Attributes] = None) -> None:
         default_io().wait()  # make the last snapshot durable
-        if self._installed_handler:
-            signal.signal(
-                signal.SIGTERM, _PREV_HANDLER.get("handler") or signal.SIG_DFL
-            )
-            self._installed_handler = False
         super().destroy(attrs)
 
     # -- save ----------------------------------------------------------------

@@ -1,0 +1,165 @@
+"""Checkpoint serialization on Orbax — the ``torch.save``/``accelerate
+save_state`` replacement.
+
+Reference mechanism (SURVEY §3.4): ``accelerator.save_state(dir)`` pickles
+``_models``/``_optimizers``/``_schedulers``/RNG plus every registered
+capsule's ``state_dict()`` into one directory, under a main-process-only gate
+that is subtly wrong multi-process (``checkpoint.py:108-129``, SURVEY §2.4).
+
+Here every snapshot is an Orbax **composite**: one item per registered
+stateful capsule, keyed by its stable registry key
+(:meth:`rocket_tpu.runtime.Runtime.register_for_checkpointing`).  Orbax gives
+us what accelerate could not on TPU pods: async saves (compute continues
+while buffers drain to disk), multi-host coordination (every host writes its
+own shards, no gather-to-host-0), and sharded restore direct to mesh layout.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+
+def _to_saveable(tree: Any) -> Any:
+    """Coerce host scalars (python int/float/bool) to numpy so every leaf is
+    array-like for Orbax."""
+
+    def leaf(x: Any) -> Any:
+        if isinstance(x, (bool, int, float)):
+            return np.asarray(x)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+class CheckpointIO:
+    """Composite save/restore with one item per capsule key."""
+
+    def __init__(self, use_async: bool = True) -> None:
+        self._use_async = use_async
+        self._checkpointer: Optional[ocp.AsyncCheckpointer] = None
+
+    def _ckptr(self):
+        if self._checkpointer is None:
+            handler = ocp.CompositeCheckpointHandler()
+            if self._use_async:
+                self._checkpointer = ocp.AsyncCheckpointer(handler)
+            else:
+                self._checkpointer = ocp.Checkpointer(handler)
+        return self._checkpointer
+
+    # -- save ---------------------------------------------------------------
+
+    def save(
+        self, path: str, items: Dict[str, Any], *, force: bool = True, wait: bool = False
+    ) -> None:
+        """Write a composite snapshot. Async by default: returns once device
+        buffers are copied out; the write itself overlaps the next steps
+        (reference blocks the loop in ``accelerator.save_state``,
+        ``checkpoint.py:129``)."""
+        path = os.path.abspath(path)
+        args = ocp.args.Composite(
+            **{
+                key: ocp.args.StandardSave(_to_saveable(tree))
+                for key, tree in items.items()
+            }
+        )
+        self._ckptr().save(path, args=args, force=force)
+        if wait:
+            self.wait()
+
+    def wait(self) -> None:
+        """Block until any in-flight async save is durable."""
+        ckptr = self._checkpointer
+        if ckptr is not None and hasattr(ckptr, "wait_until_finished"):
+            ckptr.wait_until_finished()
+
+    # -- restore ------------------------------------------------------------
+
+    def keys(self, path: str) -> List[str]:
+        path = os.path.abspath(path)
+        if not os.path.isdir(path):
+            raise FileNotFoundError(f"no checkpoint at {path}")
+        return [
+            name
+            for name in sorted(os.listdir(path))
+            if os.path.isdir(os.path.join(path, name))
+            and not name.startswith(("_", "."))
+        ]
+
+    def restore(
+        self,
+        path: str,
+        targets: Optional[Dict[str, Any]] = None,
+        keys: Optional[List[str]] = None,
+        partial: bool = False,
+    ) -> Dict[str, Any]:
+        """Restore items.
+
+        ``targets`` maps item key -> abstract pytree (``jax.ShapeDtypeStruct``
+        leaves may carry ``sharding`` for direct-to-mesh restore). Items
+        without a target restore as host numpy. ``keys`` limits which items
+        load. ``partial`` allows a target that covers only a subtree of the
+        saved state (the weights-only resume path, reference
+        ``launcher.py:349-359``: weights load, optimizer state is skipped).
+        """
+        path = os.path.abspath(path)
+        targets = targets or {}
+        want = keys if keys is not None else self.keys(path)
+        composite_args: Dict[str, Any] = {}
+        for key in want:
+            target = targets.get(key)
+            if target is None:
+                composite_args[key] = ocp.args.StandardRestore()
+            elif partial:
+                restore_args = jax.tree_util.tree_map(
+                    lambda leaf: ocp.ArrayRestoreArgs(
+                        sharding=getattr(leaf, "sharding", None),
+                        dtype=getattr(leaf, "dtype", None),
+                    ),
+                    target,
+                )
+                composite_args[key] = ocp.args.PyTreeRestore(
+                    item=target, restore_args=restore_args, partial_restore=True
+                )
+            else:
+                composite_args[key] = ocp.args.StandardRestore(target)
+        # Restores use a transient (sync) checkpointer: the shared async one
+        # binds each item key to the first args type it sees, which would
+        # conflict between StandardSave (writes) and PyTreeRestore (partial
+        # reads) on the same key.
+        with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+            result = ckptr.restore(path, args=ocp.args.Composite(**composite_args))
+        return {key: result[key] for key in want}
+
+    def restore_item(
+        self, path: str, key: str, target: Any = None, partial: bool = False
+    ) -> Any:
+        return self.restore(
+            path,
+            targets={key: target} if target is not None else None,
+            keys=[key],
+            partial=partial,
+        )[key]
+
+    def close(self) -> None:
+        self.wait()
+        if self._checkpointer is not None:
+            self._checkpointer.close()
+            self._checkpointer = None
+
+
+# A process-wide default IO — capsules share one async checkpointer so there
+# is at most one in-flight save to coordinate.
+_DEFAULT_IO: Optional[CheckpointIO] = None
+
+
+def default_io() -> CheckpointIO:
+    global _DEFAULT_IO
+    if _DEFAULT_IO is None:
+        _DEFAULT_IO = CheckpointIO()
+    return _DEFAULT_IO

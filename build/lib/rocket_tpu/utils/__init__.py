@@ -1,0 +1,23 @@
+from rocket_tpu.utils.collections import (
+    apply_to_collection,
+    is_collection,
+    tree_map,
+)
+from rocket_tpu.utils.placement import (
+    collate,
+    register_collate_hook,
+    register_default_move_hook,
+    register_move_hook,
+    to_device,
+)
+
+__all__ = [
+    "apply_to_collection",
+    "is_collection",
+    "tree_map",
+    "collate",
+    "to_device",
+    "register_collate_hook",
+    "register_move_hook",
+    "register_default_move_hook",
+]

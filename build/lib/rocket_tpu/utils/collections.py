@@ -1,0 +1,55 @@
+"""Structure-preserving maps over nested containers.
+
+Capability parity: reference ``rocket/utils/collections.py`` (``is_collection``,
+``apply_to_mapping:26``, ``apply_to_sequence:45``, ``apply_to_collection:61``).
+In the TPU build these are thin, registry-aware wrappers over
+``jax.tree_util`` — pytrees are the idiomatic generalization of the
+reference's hand-rolled container walk, and they preserve custom node types
+(e.g. :class:`~rocket_tpu.core.attributes.Attributes`) the same way the
+reference's copy+update dance preserved mapping subclasses.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Mapping, Sequence, Type
+
+import jax
+
+
+def is_collection(value: Any) -> bool:
+    """True for mappings and non-string sequences
+    (reference ``collections.py:7-24``)."""
+    if isinstance(value, (str, bytes)):
+        return False
+    return isinstance(value, (Mapping, Sequence))
+
+
+def apply_to_collection(
+    data: Any,
+    dtype: Type | tuple,
+    func: Callable[..., Any],
+    *args: Any,
+    **kwargs: Any,
+) -> Any:
+    """Apply ``func`` to every leaf of ``data`` that is an instance of
+    ``dtype``; other leaves pass through unchanged.  Container structure
+    (including dict subclasses) is preserved.
+
+    Reference ``collections.py:61-71`` — here delegated to ``jax.tree_util``
+    with ``is_leaf`` set so that matching instances are treated as leaves even
+    if they are themselves containers.
+    """
+
+    def mapper(leaf: Any) -> Any:
+        if isinstance(leaf, dtype):
+            return func(leaf, *args, **kwargs)
+        return leaf
+
+    return jax.tree_util.tree_map(
+        mapper, data, is_leaf=lambda x: isinstance(x, dtype)
+    )
+
+
+def tree_map(func: Callable[..., Any], tree: Any, *rest: Any, **kwargs: Any) -> Any:
+    """Alias for ``jax.tree_util.tree_map`` (exported for symmetry)."""
+    return jax.tree_util.tree_map(func, tree, *rest, **kwargs)

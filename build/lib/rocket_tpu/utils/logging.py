@@ -1,0 +1,83 @@
+"""Rank-aware logging.
+
+Capability parity: reference uses ``accelerate.logging.get_logger`` per capsule
+(``rocket/core/capsule.py:114``) so that a message is emitted once per run, not
+once per process.  Here the rank check is JAX-native: ``jax.process_index()``,
+evaluated lazily at log time so importing this module never initializes the
+backend.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Any
+
+_FORMAT = "%(asctime)s [%(levelname)s] %(name)s: %(message)s"
+_configured = False
+
+
+def _ensure_root_config() -> None:
+    global _configured
+    if _configured:
+        return
+    level = os.environ.get("ROCKET_TPU_LOG_LEVEL", "INFO").upper()
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    root = logging.getLogger("rocket_tpu")
+    if not root.handlers:
+        root.addHandler(handler)
+    root.setLevel(level)
+    root.propagate = False
+    _configured = True
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:  # backend not ready yet — behave like rank 0
+        return 0
+
+
+class RankAwareLogger:
+    """Wraps a stdlib logger; by default only the main process emits.
+
+    Pass ``all_ranks=True`` (or ``main_process_only=False`` per call) to emit
+    from every process, prefixed with the process index.
+    """
+
+    def __init__(self, name: str, all_ranks: bool = False) -> None:
+        _ensure_root_config()
+        self._logger = logging.getLogger(f"rocket_tpu.{name}")
+        self._all_ranks = all_ranks
+
+    def _log(self, level: int, msg: str, *args: Any, **kwargs: Any) -> None:
+        main_only = kwargs.pop("main_process_only", not self._all_ranks)
+        rank = _process_index()
+        if main_only and rank != 0:
+            return
+        if not main_only and rank != 0:
+            msg = f"[rank {rank}] {msg}"
+        self._logger.log(level, msg, *args, **kwargs)
+
+    def debug(self, msg: str, *args: Any, **kwargs: Any) -> None:
+        self._log(logging.DEBUG, msg, *args, **kwargs)
+
+    def info(self, msg: str, *args: Any, **kwargs: Any) -> None:
+        self._log(logging.INFO, msg, *args, **kwargs)
+
+    def warning(self, msg: str, *args: Any, **kwargs: Any) -> None:
+        self._log(logging.WARNING, msg, *args, **kwargs)
+
+    def error(self, msg: str, *args: Any, **kwargs: Any) -> None:
+        self._log(logging.ERROR, msg, *args, **kwargs)
+
+    def setLevel(self, level: int | str) -> None:
+        self._logger.setLevel(level)
+
+
+def get_logger(name: str, all_ranks: bool = False) -> RankAwareLogger:
+    return RankAwareLogger(name, all_ranks=all_ranks)

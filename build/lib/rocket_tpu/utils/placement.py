@@ -1,0 +1,146 @@
+"""Collate and device-placement with user-registerable type hooks.
+
+Capability parity: reference ``rocket/utils/torch.py`` — ``torch_collate``
+(:30, hook table ``COLLATE_MAPPINGS``: only tensors stack, everything else
+passes through as lists) and ``torch_move``/``move`` (:59-95, hook table
+``MOVE_MAPPINGS`` + ``register_move_hook``/``register_default_move_hook``).
+
+TPU-first differences: "move to device" becomes ``jax.device_put`` with an
+optional :class:`jax.sharding.Sharding`, so the same call that placed a batch
+on one GPU in the reference now lays a **global** batch out across a device
+mesh.  Numpy is the host-side interchange format; torch tensors (cpu) are
+converted transparently when torch is importable so reference-style torch
+Datasets keep working.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Type
+
+import jax
+import numpy as np
+
+# -- hook tables -------------------------------------------------------------
+
+CollateHook = Callable[[Sequence[Any]], Any]
+MoveHook = Callable[[Any, Any], Any]
+
+COLLATE_HOOKS: Dict[Type, CollateHook] = {}
+MOVE_HOOKS: Dict[Type, MoveHook] = {}
+_DEFAULT_MOVE_HOOK: Optional[MoveHook] = None
+
+
+def register_collate_hook(dtype: Type, func: CollateHook) -> None:
+    """Register a stacker for a leaf type (reference ``torch.py:17-26``)."""
+    COLLATE_HOOKS[dtype] = func
+
+
+def register_move_hook(dtype: Type, func: MoveHook) -> None:
+    """Register a device-placement hook for a leaf type
+    (reference ``torch.py:88-92``)."""
+    MOVE_HOOKS[dtype] = func
+
+
+def register_default_move_hook(func: MoveHook) -> None:
+    """Fallback hook for unmatched leaf types (reference ``torch.py:94-95``)."""
+    global _DEFAULT_MOVE_HOOK
+    _DEFAULT_MOVE_HOOK = func
+
+
+def _to_numpy(value: Any) -> Any:
+    """Best-effort conversion of a leaf to a numpy array; None if not array-like."""
+    if isinstance(value, np.ndarray):
+        return value
+    if isinstance(value, (np.generic, int, float, bool)):
+        return np.asarray(value)
+    if isinstance(value, jax.Array):
+        return np.asarray(value)
+    # torch cpu tensors from reference-style datasets
+    tt = _torch_tensor_type()
+    if tt is not None and isinstance(value, tt):
+        return value.detach().cpu().numpy()
+    return None
+
+
+def _torch_tensor_type():
+    try:
+        import torch
+
+        return torch.Tensor
+    except Exception:  # torch not importable — numpy-only mode
+        return None
+
+
+# -- collate -----------------------------------------------------------------
+
+def collate(samples: Sequence[Any]) -> Any:
+    """Stack a list of samples (pytrees) into a batch pytree.
+
+    Array-like leaves (numpy / jax / torch-cpu / python scalars) are stacked
+    along a new leading axis into numpy arrays; any other leaf type passes
+    through as a plain list — the reference's "only tensors collate" contract
+    (``rocket/utils/torch.py:17-34``).
+    """
+    if not samples:
+        return samples
+    first = samples[0]
+    for dtype, hook in COLLATE_HOOKS.items():
+        if isinstance(first, dtype):
+            return hook(samples)
+    if isinstance(first, dict):
+        out = {key: collate([s[key] for s in samples]) for key in first}
+        return type(first)(out)
+    if isinstance(first, (list, tuple)) and not isinstance(first, str):
+        transposed = [collate(list(group)) for group in zip(*samples)]
+        if isinstance(first, tuple):
+            return tuple(transposed)
+        return transposed
+    arr = _to_numpy(first)
+    if arr is not None:
+        return np.stack([_to_numpy(s) for s in samples])
+    return list(samples)
+
+
+# -- device placement --------------------------------------------------------
+
+def _adapt_sharding(sharding: Any, ndim: int) -> Any:
+    """Fit a NamedSharding's PartitionSpec to a leaf's rank: truncate extra
+    dims, pad missing ones with None (replicated).  Lets one batch sharding
+    (leading dim over the data axes) serve mixed-rank leaves — images,
+    labels, masks — the way the reference's per-leaf ``.to(device)`` did."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    if not isinstance(sharding, NamedSharding):
+        return sharding
+    spec = tuple(sharding.spec)
+    if len(spec) == ndim:
+        return sharding
+    if len(spec) > ndim:
+        spec = spec[:ndim]
+    else:
+        spec = spec + (None,) * (ndim - len(spec))
+    return NamedSharding(sharding.mesh, PartitionSpec(*spec))
+
+
+def to_device(data: Any, sharding: Any = None) -> Any:
+    """Place every array leaf of ``data`` on device(s).
+
+    ``sharding`` may be a :class:`jax.sharding.Sharding`, a device, or None
+    (commit to the default device).  Structure is preserved; non-array leaves
+    pass through unless a move hook matches (reference ``torch.py:59-95``).
+    """
+
+    def move_leaf(leaf: Any) -> Any:
+        for dtype, hook in MOVE_HOOKS.items():
+            if isinstance(leaf, dtype):
+                return hook(leaf, sharding)
+        arr = leaf if isinstance(leaf, (np.ndarray, jax.Array)) else _to_numpy(leaf)
+        if arr is not None:
+            if sharding is None:
+                return jax.device_put(arr)
+            return jax.device_put(arr, _adapt_sharding(sharding, arr.ndim))
+        if _DEFAULT_MOVE_HOOK is not None:
+            return _DEFAULT_MOVE_HOOK(leaf, sharding)
+        return leaf
+
+    return jax.tree_util.tree_map(move_leaf, data)

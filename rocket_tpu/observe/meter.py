@@ -16,11 +16,23 @@ inside ``gather_for_metrics`` is explicit here — the data loader marks padded
 rows in the batch's ``_valid`` mask and the Meter drops them before the
 metrics see the data (static batch shapes on device, exact sample counts on
 host; SURVEY §7.4).
+
+Two accumulation modes (SURVEY §5.5 asks for in-step reduction):
+
+- ``mode='host'`` (reference semantics): gather the listed keys to host
+  numpy every iteration, dispatch to arbitrary :class:`Metric` children —
+  flexible, but one cross-host transfer per eval batch.
+- ``mode='in_step'``: children are :class:`StatMetric`\\ s contributing a
+  PURE sum-reducible stats function; the Meter jit-compiles
+  ``acc = acc + stats(batch)`` and accumulates ON DEVICE — the reduction
+  over the sharded batch compiles into the same program (psum over the
+  mesh), and the only host transfer is one tiny scalar tree per CYCLE at
+  ``reset``.
 """
 
 from __future__ import annotations
 
-from typing import Any, Iterable, List, Optional, Sequence
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
@@ -58,38 +70,137 @@ class Metric(Capsule):
         raise NotImplementedError
 
 
+class StatMetric(Metric):
+    """A metric whose accumulation is a PURE sum over per-batch statistics —
+    the in-step reduction protocol (SURVEY §5.5).
+
+    Subclasses implement:
+
+    - ``stats(batch) -> dict[str, Array]``: traced inside jit; must honor the
+      loader's ``_valid`` mask (padded rows of the final partial batch) and
+      return sum-reducible arrays (counts, sums);
+    - ``finalize(stats) -> dict[str, float]``: host-side, turns the summed
+      stats into named values (pushed to the tracker / loop state at reset).
+    """
+
+    def __init__(self, tag: str = "metric", **kwargs) -> None:
+        super().__init__(**kwargs)
+        self._tag = tag
+        self.last: Optional[Dict[str, float]] = None
+
+    def stats(self, batch: Any) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def finalize(self, stats: Dict[str, Any]) -> Dict[str, float]:
+        raise NotImplementedError
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        pass  # accumulation happens inside the Meter's jitted step
+
+    def reset(self, attrs: Optional[Attributes] = None) -> None:
+        pass  # finalization driven by Meter.reset with the summed stats
+
+    def _publish(self, values: Dict[str, float], attrs: Optional[Attributes]) -> None:
+        self.last = values
+        if attrs is not None and attrs.tracker is not None:
+            attrs.tracker.scalars.append(
+                Attributes(step=self._step, data=dict(values))
+            )
+        if attrs is not None and attrs.looper is not None:
+            state = attrs.looper.state
+            if state is not None:
+                for name, value in values.items():
+                    state[name] = value
+
+
+class Accuracy(StatMetric):
+    """Stock top-1 accuracy as a :class:`StatMetric` (the reference example's
+    metric, ``examples/mnist.py:20-39``, in in-step form)."""
+
+    def __init__(
+        self,
+        tag: str = "accuracy",
+        logits_key: str = "logits",
+        labels_key: str = "label",
+        **kwargs,
+    ) -> None:
+        super().__init__(tag=tag, **kwargs)
+        self._logits_key = logits_key
+        self._labels_key = labels_key
+
+    def stats(self, batch: Any) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        pred = batch[self._logits_key].argmax(-1)
+        label = batch[self._labels_key]
+        hit = (pred == label).astype(jnp.float32)
+        valid = batch.get("_valid") if hasattr(batch, "get") else None
+        if valid is not None:
+            valid = valid.astype(jnp.float32)
+            return {"correct": (hit * valid).sum(), "count": valid.sum()}
+        return {"correct": hit.sum(), "count": jnp.float32(hit.size)}
+
+    def finalize(self, stats: Dict[str, Any]) -> Dict[str, float]:
+        count = max(float(stats["count"]), 1.0)
+        return {self._tag: float(stats["correct"]) / count}
+
+
 class Meter(Dispatcher):
-    """Gather batch keys globally, then run child metrics on exact
-    (dedup-masked) host arrays.
+    """Distributed eval metrics in one of two modes (see module docstring).
 
     Parameters
     ----------
     keys:
-        Batch keys to gather (sorted, reference ``meter.py:54-61``).
+        Batch keys to gather in host mode (sorted, reference
+        ``meter.py:54-61``); ignored by in-step mode (stats fns read the
+        device batch directly).
     capsules:
-        Child :class:`Metric` instances.
+        Child :class:`Metric` (host mode) / :class:`StatMetric` (in-step
+        mode) instances.
     mask_key:
         Valid-row mask published by the data loader (drop padded rows).
+    mode:
+        ``'host'`` or ``'in_step'``.
     """
 
     def __init__(
         self,
-        keys: Sequence[str],
+        keys: Sequence[str] = (),
         capsules: Iterable[Capsule] = (),
         mask_key: str = "_valid",
+        mode: str = "host",
         statefull: bool = False,
         priority: int = 1000,
         logger: Optional[Any] = None,
     ) -> None:
+        if mode not in ("host", "in_step"):
+            raise ValueError(f"Meter mode must be 'host' or 'in_step', got {mode!r}")
+        self._keys: List[str] = sorted(keys)
+        self._mask_key = mask_key
+        self._mode = mode
+        self._acc: Optional[List[Dict[str, Any]]] = None  # per-child stat sums
+        self._accumulate: Optional[Callable] = None
+        # super() last: Dispatcher.__init__ runs guard(), which needs _mode.
         super().__init__(
             capsules=capsules, statefull=statefull, priority=priority, logger=logger
         )
-        self._keys: List[str] = sorted(keys)
-        self._mask_key = mask_key
 
     def guard(self) -> None:
         super().guard()
         for capsule in self._capsules:
+            if self._mode == "in_step" and not isinstance(capsule, StatMetric):
+                raise TypeError(
+                    f"Meter(mode='in_step') children must be StatMetrics, "
+                    f"got {type(capsule).__name__}"
+                )
+            if self._mode == "host" and isinstance(capsule, StatMetric):
+                # StatMetric.launch/reset are no-ops — in host mode it would
+                # silently never publish anything.
+                raise TypeError(
+                    f"{type(capsule).__name__} is a StatMetric — use "
+                    f"Meter(mode='in_step') (host mode would silently drop "
+                    f"its results)"
+                )
             if not isinstance(capsule, Metric):
                 raise TypeError(
                     f"Meter children must be Metrics, got "
@@ -102,6 +213,47 @@ class Meter(Dispatcher):
         looper = attrs.looper
         if looper is not None and looper.grad_enabled:
             return  # eval-only (reference ``meter.py:84-85``)
+        if self._mode == "in_step":
+            self._launch_in_step(attrs)
+        else:
+            self._launch_host(attrs)
+
+    # -- in-step mode ---------------------------------------------------------
+
+    def _launch_in_step(self, attrs: Attributes) -> None:
+        import jax
+
+        if self._accumulate is None:
+            metrics = list(self._capsules)
+
+            def accumulate(acc, batch):
+                stats = [m.stats(batch) for m in metrics]
+                if acc is None:
+                    return stats
+                return jax.tree_util.tree_map(
+                    lambda a, s: a + s, acc, stats
+                )
+
+            # Two compiled variants (first batch has no acc); both stay on
+            # device — no host sync anywhere in the eval loop.
+            self._accumulate = jax.jit(accumulate)
+        self._acc = self._accumulate(self._acc, attrs.batch)
+        for capsule in self._capsules:
+            capsule.launch(attrs)  # no-op hook kept for subclass hybrids
+
+    def reset(self, attrs: Optional[Attributes] = None) -> None:
+        if self._mode == "in_step" and self._acc is not None:
+            # THE one host transfer per eval cycle.
+            host_stats = to_host_global(self._acc)
+            self._acc = None
+            for metric, stats in zip(self._capsules, host_stats):
+                values = metric.finalize(stats)
+                metric._publish(values, attrs)
+        super().reset(attrs)
+
+    # -- host mode (reference semantics) --------------------------------------
+
+    def _launch_host(self, attrs: Attributes) -> None:
         batch = attrs.batch
         wanted = {}
         for key in self._keys:

@@ -243,6 +243,104 @@ def test_retention_keep_last_survives_restart(tmp_path, devices):
     assert len(remaining) == 2, remaining
 
 
+def test_preemption_sigterm_saves_and_resumes(tmp_path, devices):
+    """SIGTERM mid-epoch: the Checkpointer writes a durable snapshot at the
+    next iteration boundary, terminates the loop inside the grace window,
+    and a resume from that snapshot restores bitwise-identical params
+    (SURVEY §5.3; VERDICT r1 item 8)."""
+    import signal
+
+    import jax
+    import jax.numpy as jnp
+
+    class Preemptor(rt.Capsule):
+        """Delivers SIGTERM to our own process on iteration 2 (between the
+        train step and the Checkpointer, like a real preemption notice)."""
+
+        def __init__(self):
+            super().__init__(statefull=False, priority=500)
+            self._iters = 0
+
+        def launch(self, attrs=None):
+            if self._iters == 2:
+                signal.raise_signal(signal.SIGTERM)
+            self._iters += 1
+
+    data = synthetic_classification(n=512)  # 8 iters/epoch at bs 64
+    model = rt.Module(
+        MLP(),
+        capsules=[
+            rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+            rt.Optimizer(learning_rate=2e-2),
+        ],
+    )
+    looper = rt.Looper(
+        capsules=[
+            rt.Dataset(rt.ArraySource(data), batch_size=64, shuffle=True, seed=7),
+            model,
+            Preemptor(),
+            rt.Checkpointer(save_every=100),  # periodic cadence never fires
+        ],
+        progress=False,
+    )
+    launcher = rt.Launcher(
+        capsules=[looper], tag="pre", num_epochs=1, project_root=str(tmp_path)
+    )
+    launcher.launch()
+
+    # loop stopped early: only 3 of 8 iterations ran, snapshot at iter 2
+    assert model.step == 3
+    ckpts = sorted((tmp_path / "pre" / "v0" / "weights").iterdir())
+    assert [c.name for c in ckpts] == ["000002"]
+    final_params = jax.device_get(model.state.params)
+
+    launcher2, model2 = _tree(
+        tmp_path, data, epochs=0, resume=str(ckpts[0]), load_capsules=True,
+        input_spec={
+            "x": jax.ShapeDtypeStruct((64, 16), jnp.float32),
+            "label": jax.ShapeDtypeStruct((64,), jnp.int32),
+        },
+    )
+    launcher2.launch()
+    assert model2.step == 3  # restored post-save step counter
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal,
+        final_params,
+        jax.device_get(model2.state.params),
+    )
+    # handler restored after destroy (ours is gone)
+    from rocket_tpu.persist.checkpoint import _on_sigterm
+
+    assert signal.getsignal(signal.SIGTERM) is not _on_sigterm
+
+
+def test_sigterm_handler_single_install(tmp_path, devices):
+    """Two Checkpointers (train + eval looper) share ONE handler install —
+    a second install must not make the handler its own 'previous' (which
+    would recurse on a real SIGTERM)."""
+    import signal
+
+    from rocket_tpu.persist import checkpoint as cp
+
+    runtime = rt.Runtime()
+    runtime.project_dir = str(tmp_path / "dup")
+    c1 = rt.Checkpointer(save_every=10)
+    c2 = rt.Checkpointer(save_every=10)
+    before = signal.getsignal(signal.SIGTERM)
+    try:
+        for c in (c1, c2):
+            c.bind(runtime)
+            c.setup()
+        assert c1._installed_handler and not c2._installed_handler
+        assert cp._PREV_HANDLER["handler"] is not cp._on_sigterm
+        cp._preempted.clear()
+        signal.raise_signal(signal.SIGTERM)  # must not recurse
+        assert cp._preempted.is_set()
+    finally:
+        cp._preempted.clear()
+        signal.signal(signal.SIGTERM, before)
+
+
 def test_topology_guard(tmp_path, devices):
     """Resume refuses a different process count (reference
     launcher.py:370-375). Single-process env: simulate by editing the saved
