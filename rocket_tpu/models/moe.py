@@ -1,0 +1,134 @@
+"""Mixture-of-Experts MLP — makes the mesh's ``expert`` axis real.
+
+The reference has no MoE (no model code at all, SURVEY §5.7); this is the
+beyond-parity expert-parallel path, built the TPU way (GShard/Switch
+recipe):
+
+- routing is **static-shaped**: top-k gates with a fixed per-expert
+  capacity ``C = ceil(k * S * capacity_factor / E)``; overflow tokens are
+  dropped (their combine weight is zero) — no dynamic shapes under jit;
+- dispatch/combine are **einsums** against one-hot tensors, so the whole
+  layer is MXU matmuls and XLA inserts the all-to-alls from the shardings
+  (batch on the data axes, expert weights on the ``expert`` axis) — no
+  hand-written collectives;
+- expert weights are 3-D ``[E, D, F]`` with logical axes
+  ``('expert', 'embed', 'mlp')``: expert-parallel over the ``expert`` mesh
+  axis and tensor-parallel over ``tensor`` simultaneously.
+
+Load balancing: the standard Switch aux loss ``E * Σ_e f_e · p_e`` is
+returned by the layer; :class:`~rocket_tpu.models.transformer.Block` threads
+it out and ``TransformerLM`` publishes the per-batch total as
+``batch['moe_aux']`` — add ``rt.Loss(moe_aux_loss(), weight=0.01)`` to
+train against it (blackboard contract, reference ``module.py:139``).
+"""
+
+from __future__ import annotations
+
+import math
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu.models.layers import _init
+
+
+class MoEMLP(nn.Module):
+    """Top-k routed expert MLP (GELU experts).
+
+    Attributes
+    ----------
+    n_experts: number of experts ``E``.
+    mlp_dim: hidden width ``F`` of each expert.
+    top_k: experts per token (1 = Switch, 2 = GShard default).
+    capacity_factor: slack over the perfectly-balanced per-expert load.
+    use_bias: bias on the expert projections.
+    """
+
+    n_experts: int
+    mlp_dim: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, S, D = x.shape
+        E, F, K = self.n_experts, self.mlp_dim, self.top_k
+        if K > E:
+            raise ValueError(f"top_k {K} > n_experts {E}")
+        capacity = max(4, math.ceil(K * S * self.capacity_factor / E))
+
+        # -- routing (f32 for a stable softmax regardless of compute dtype)
+        router = self.param(
+            "router", _init(nn.initializers.lecun_normal(), "embed", "expert"),
+            (D, E),
+        )
+        logits = jnp.einsum("bsd,de->bse", x, router.astype(x.dtype))
+        gates = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [B,S,E]
+
+        top_vals, top_idx = jax.lax.top_k(gates, K)  # [B,S,K]
+        top_vals = top_vals / jnp.maximum(
+            top_vals.sum(-1, keepdims=True), 1e-9
+        )
+
+        # -- static-capacity dispatch: process the K slots in order; slot j
+        # sees the seats already taken by slots < j (GShard cumsum trick).
+        combine = jnp.zeros((B, S, E, capacity), dtype=jnp.float32)
+        taken = jnp.zeros((B, 1, E), dtype=jnp.int32)  # seats used per expert
+        for j in range(K):
+            mask_j = jax.nn.one_hot(top_idx[..., j], E, dtype=jnp.int32)
+            pos = jnp.cumsum(mask_j, axis=1) - 1 + taken  # seat index [B,S,E]
+            fits = (pos < capacity) & (mask_j > 0)
+            seat = jax.nn.one_hot(
+                jnp.where(fits, pos, 0).sum(-1), capacity, dtype=jnp.float32
+            )  # [B,S,C] — each token occupies one seat of its chosen expert
+            combine = combine + (
+                top_vals[..., j, None, None]
+                * fits.astype(jnp.float32)[..., None]
+                * seat[:, :, None, :]
+            )
+            taken = taken + mask_j.sum(axis=1, keepdims=True)
+
+        dispatch = (combine > 0).astype(x.dtype)  # [B,S,E,C]
+
+        # -- expert computation: everything below is einsums; GSPMD turns the
+        # B<->E resharding into all-to-alls over the mesh.
+        w_up = self.param(
+            "w_up", _init(nn.initializers.lecun_normal(), "expert", "embed", "mlp"),
+            (E, D, F),
+        )
+        w_down = self.param(
+            "w_down", _init(nn.initializers.lecun_normal(), "expert", "mlp", "embed"),
+            (E, F, D),
+        )
+        expert_in = jnp.einsum("bsec,bsd->ebcd", dispatch, x)
+        h = jnp.einsum("ebcd,edf->ebcf", expert_in, w_up.astype(x.dtype))
+        if self.use_bias:
+            b_up = self.param(
+                "b_up", _init(nn.initializers.zeros_init(), "expert", "mlp"),
+                (E, F),
+            )
+            h = h + b_up.astype(x.dtype)[:, None, None, :]
+        h = nn.gelu(h)
+        expert_out = jnp.einsum("ebcf,efd->ebcd", h, w_down.astype(x.dtype))
+        y = jnp.einsum("bsec,ebcd->bsd", combine.astype(x.dtype), expert_out)
+
+        # -- Switch load-balancing aux: E * Σ_e (fraction routed to e as
+        # slot-0 choice) * (mean gate prob of e); minimized at uniform.
+        f_e = jnp.mean(
+            jax.nn.one_hot(top_idx[..., 0], E, dtype=jnp.float32), axis=(0, 1)
+        )
+        p_e = jnp.mean(gates, axis=(0, 1))
+        aux = E * jnp.sum(f_e * p_e)
+        return y, aux
+
+
+def moe_aux_loss(key: str = "moe_aux"):
+    """Objective reading the LM's published load-balancing aux
+    (``rt.Loss(moe_aux_loss(), name='moe_aux', weight=0.01)``)."""
+
+    def fn(batch):
+        return batch[key]
+
+    return fn

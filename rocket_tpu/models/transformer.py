@@ -75,6 +75,17 @@ class TransformerConfig:
     scan_layers: bool = False
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Mixture-of-Experts (0 = dense MLP). Experts shard over the mesh's
+    # 'expert' axis; see rocket_tpu.models.moe.
+    n_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    # Pipeline parallelism (0 = off): split the batch into this many
+    # microbatches and GPipe the blocks over the mesh's 'pipe' axis
+    # (rocket_tpu.parallel.pipeline). Requires dropout == 0 and divides
+    # n_layers by the pipe-axis size; layer params shard over 'pipe' via
+    # the 'stage' logical axis.
+    pipeline_microbatches: int = 0
 
     @property
     def kv_heads(self) -> int:
@@ -245,6 +256,9 @@ class MLP(nn.Module):
 
 
 class Block(nn.Module):
+    """Returns ``(x, aux)`` — aux is the MoE load-balancing loss
+    contribution (0.0 for dense blocks)."""
+
     config: TransformerConfig
 
     @nn.compact
@@ -254,8 +268,85 @@ class Block(nn.Module):
         x = x + Attention(cfg, name="attn")(
             _Norm(cfg, name="ln1")(x), positions, segment_ids, train
         )
-        x = x + MLP(cfg, name="mlp")(_Norm(cfg, name="ln2")(x), train)
-        return constrain(x, "batch", "sequence", "act_embed")
+        aux = jnp.zeros((), jnp.float32)
+        h = _Norm(cfg, name="ln2")(x)
+        if cfg.n_experts > 0:
+            from rocket_tpu.models.moe import MoEMLP
+
+            y, aux = MoEMLP(
+                n_experts=cfg.n_experts,
+                mlp_dim=cfg.mlp_dim,
+                top_k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                use_bias=cfg.use_bias,
+                name="moe",
+            )(h, train)
+        else:
+            y = MLP(cfg, name="mlp")(h, train)
+        x = x + y
+        return constrain(x, "batch", "sequence", "act_embed"), aux
+
+
+class PipelinedBlocks(nn.Module):
+    """The block stack, GPipe-pipelined over the mesh's ``pipe`` axis.
+
+    Parameters are created by the same ``nn.scan`` stacking as
+    ``scan_layers`` but with the ``stage`` logical name on the layer dim
+    (rule: ``stage -> pipe``), so each pipeline stage holds its ``L/P``
+    layer slice.  At apply time the stacked params are read back and driven
+    through :func:`rocket_tpu.parallel.pipeline.gpipe` — microbatches flow
+    stage-to-stage over ICI ``ppermute``.  Constraints: ``dropout == 0``
+    (the pure per-layer fn carries no rng) and no MoE aux (returns 0).
+    """
+
+    config: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x, positions, train: bool):
+        cfg = self.config
+        if cfg.dropout:
+            raise ValueError("pipeline_microbatches requires dropout=0.0")
+        if self.is_initializing():
+            # Sequential pass purely to create the stacked params (same
+            # structure scan_layers would make, 'stage' on the layer dim).
+            out, _ = nn.scan(
+                lambda mdl, carry, _: mdl(carry, positions, None, train),
+                variable_axes={"params": 0},
+                split_rngs={"params": True},
+                length=cfg.n_layers,
+                metadata_params={nn.PARTITION_NAME: "stage"},
+            )(Block(cfg, name="blocks"), x, None)
+            return out
+        from rocket_tpu.parallel.context import current_mesh
+        from rocket_tpu.parallel.pipeline import gpipe
+
+        mesh = current_mesh()
+        if mesh is None:
+            raise RuntimeError(
+                "PipelinedBlocks needs an active mesh context (run through "
+                "Module/Runtime, or wrap in parallel.context.mesh_context)"
+            )
+        n_micro = cfg.pipeline_microbatches
+        B, S, D = x.shape
+        if B % n_micro != 0:
+            raise ValueError(
+                f"batch {B} not divisible by {n_micro} microbatches"
+            )
+        micro_b = B // n_micro
+        stacked = nn.meta.unbox(
+            self.scope.get_variable("params", "blocks")
+        )
+        pos_micro = positions[:micro_b]
+
+        def one_layer(layer_params, h):
+            out, _ = Block(cfg).apply(
+                {"params": layer_params}, h, pos_micro, None, train
+            )
+            return out
+
+        xs = x.reshape(n_micro, micro_b, S, D)
+        ys = gpipe(one_layer, stacked, xs, mesh=mesh, axis="pipe")
+        return ys.reshape(B, S, D)
 
 
 class TransformerLM(nn.Module):
@@ -302,22 +393,25 @@ class TransformerLM(nn.Module):
             block_cls = nn.remat(
                 Block, static_argnums=(4,), prevent_cse=False
             )
-        if cfg.scan_layers:
-            x, _ = nn.scan(
-                lambda mdl, carry, _: (
-                    mdl(carry, positions, segment_ids, train),
-                    None,
-                ),
+        if cfg.pipeline_microbatches > 0:
+            x = PipelinedBlocks(cfg, name="pipeline")(x, positions, train)
+            moe_aux = jnp.zeros((), jnp.float32)
+        elif cfg.scan_layers:
+            x, aux_per_layer = nn.scan(
+                lambda mdl, carry, _: mdl(carry, positions, segment_ids, train),
                 variable_axes={"params": 0},
                 split_rngs={"params": True, "dropout": True},
                 length=cfg.n_layers,
                 metadata_params={nn.PARTITION_NAME: "layers"},
             )(block_cls(cfg, name="blocks"), x, None)
+            moe_aux = jnp.sum(aux_per_layer)
         else:
+            moe_aux = jnp.zeros((), jnp.float32)
             for i in range(cfg.n_layers):
-                x = block_cls(cfg, name=f"block_{i}")(
+                x, aux = block_cls(cfg, name=f"block_{i}")(
                     x, positions, segment_ids, train
                 )
+                moe_aux = moe_aux + aux
 
         x = _Norm(cfg, name="ln_f")(x)
         if cfg.tie_embeddings:
@@ -329,4 +423,8 @@ class TransformerLM(nn.Module):
         logits = constrain(logits, "batch", "sequence", "vocab")
         out = Attributes(batch) if hasattr(batch, "get") else Attributes(batch)
         out[self.logits_key] = logits
+        if cfg.n_experts > 0:
+            # Blackboard contract: downstream Loss(moe_aux_loss()) trains
+            # against it (rocket_tpu.models.moe).
+            out["moe_aux"] = moe_aux
         return out

@@ -109,7 +109,7 @@ class ViT(nn.Module):
             block = Block(enc, name=f"block_{i}")
             if enc.remat:
                 block = nn.remat(Block, static_argnums=(4,))(enc, name=f"block_{i}")
-            x = block(x, positions, None, train)
+            x, _ = block(x, positions, None, train)
 
         x = _Norm(enc, name="ln_f")(x)
         logits = nn.Dense(cfg.num_classes, dtype=cdtype, name="head")(x[:, 0])

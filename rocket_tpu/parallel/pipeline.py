@@ -1,0 +1,125 @@
+"""Pipeline parallelism over the mesh's ``pipe`` axis — GPipe on ICI.
+
+The reference has no pipeline parallelism (SURVEY §2.2 lists PP as absent;
+the mesh API must merely not preclude it).  This makes the ``pipe`` axis
+real, the TPU way:
+
+- the layer-stacked parameters (the ``nn.scan`` layout, leading ``layers``
+  dim) are **sharded over ``pipe``** — each stage holds ``L/P`` layers;
+- activations flow stage-to-stage via ``lax.ppermute`` inside one
+  ``shard_map``-ped program: microbatch ``m`` enters stage 0 at tick ``m``,
+  reaches stage ``p`` at tick ``m + p`` (the classic GPipe schedule with
+  ``P - 1`` bubble ticks at each end);
+- every stage runs the identical SPMD program; bubbles are masked
+  ``where``s, so shapes are static and XLA overlaps the ``ppermute`` with
+  the next tick's compute;
+- the backward pass needs no hand-written schedule: ``ppermute``
+  transposes to the reverse rotation under ``jax.grad``, giving the
+  reverse pipeline automatically.
+
+This is the micro-scale version of the scaling-book recipe: express the
+schedule as collectives, let XLA pick the overlap.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+Carry = Any
+
+
+def _chunk_apply(fn: Callable, local_params: Any, x: Any) -> Any:
+    """Apply this stage's stack of layers (leading dim = local layers)."""
+
+    def body(carry, layer_params):
+        return fn(layer_params, carry), None
+
+    out, _ = jax.lax.scan(body, x, local_params)
+    return out
+
+
+def gpipe(
+    fn: Callable[[Any, Any], Any],
+    stacked_params: Any,
+    xs: jax.Array,
+    mesh: Mesh,
+    axis: str = "pipe",
+    xs_spec: Optional[P] = None,
+) -> jax.Array:
+    """Run ``xs`` (microbatched on dim 0) through layer-stacked params,
+    pipelined over ``mesh`` axis ``axis``.
+
+    Parameters
+    ----------
+    fn:
+        ``fn(one_layer_params, x) -> x`` — a single layer.
+    stacked_params:
+        pytree whose leaves have a leading layer dim ``L`` with
+        ``L % P == 0`` (``P`` = size of the pipe axis).
+    xs:
+        ``[n_micro, micro_batch, ...]`` microbatched input.
+    xs_spec:
+        PartitionSpec for dims ``1:`` of ``xs``/output (e.g. batch sharded
+        over data axes); default fully replicated.
+
+    Returns ``ys`` with the same shape/sharding as ``xs``.
+    """
+    n_stages = mesh.shape[axis]
+    n_micro = xs.shape[0]
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] % n_stages != 0:
+            raise ValueError(
+                f"layer dim {leaf.shape[0]} not divisible by {n_stages} "
+                f"pipeline stages"
+            )
+    if n_stages == 1:
+        return _chunk_apply(fn, stacked_params, xs)
+
+    inner = xs_spec if xs_spec is not None else P()
+    xs_full_spec = P(None, *inner)
+    param_spec = jax.tree_util.tree_map(
+        lambda leaf: P(axis, *([None] * (leaf.ndim - 1))), stacked_params
+    )
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def stage_program(local_params, xs_local):
+        p = jax.lax.axis_index(axis)
+        ticks = n_micro + n_stages - 1
+
+        def tick(carry, t):
+            act, ys = carry
+            feed = xs_local[jnp.minimum(t, n_micro - 1)]
+            # stage 0 ingests microbatch t (zeros in the drain phase)
+            act = jnp.where(p == 0, jnp.where(t < n_micro, feed, 0.0), act)
+            y = _chunk_apply(fn, local_params, act)
+            # last stage emits microbatch t-(P-1) during the fill phase's end
+            out_idx = t - (n_stages - 1)
+            updated = jax.lax.dynamic_update_index_in_dim(
+                ys, y, jnp.maximum(out_idx, 0), 0
+            )
+            ys = jnp.where((p == n_stages - 1) & (out_idx >= 0), updated, ys)
+            act = jax.lax.ppermute(y, axis, perm)
+            return (act, ys), None
+
+        act0 = jnp.zeros_like(xs_local[0])
+        ys0 = jnp.zeros_like(xs_local)
+        (_, ys), _ = jax.lax.scan(
+            tick, (act0, ys0), jnp.arange(ticks)
+        )
+        # only the last stage's buffer is the real output; replicate it
+        ys = jax.lax.psum(
+            jnp.where(p == n_stages - 1, ys, 0.0), axis
+        )
+        return ys
+
+    return jax.shard_map(
+        stage_program,
+        mesh=mesh,
+        in_specs=(param_spec, xs_full_spec),
+        out_specs=xs_full_spec,
+        check_vma=False,
+    )(stacked_params, xs)
