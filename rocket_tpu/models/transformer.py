@@ -302,7 +302,7 @@ class PipelinedBlocks(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, train: bool):
+    def __call__(self, x, positions, segment_ids, train: bool):
         cfg = self.config
         if cfg.dropout:
             raise ValueError("pipeline_microbatches requires dropout=0.0")
@@ -310,7 +310,7 @@ class PipelinedBlocks(nn.Module):
             # Sequential pass purely to create the stacked params (same
             # structure scan_layers would make, 'stage' on the layer dim).
             out, _ = nn.scan(
-                lambda mdl, carry, _: mdl(carry, positions, None, train),
+                lambda mdl, carry, _: mdl(carry, positions, segment_ids, train),
                 variable_axes={"params": 0},
                 split_rngs={"params": True},
                 length=cfg.n_layers,
@@ -336,17 +336,27 @@ class PipelinedBlocks(nn.Module):
         stacked = nn.meta.unbox(
             self.scope.get_variable("params", "blocks")
         )
-        pos_micro = positions[:micro_b]
+        # Per-microbatch side inputs (positions, segment ids) ride the
+        # pipeline rotation as extra activation leaves — each microbatch
+        # keeps ITS positions as it flows stage to stage.
+        has_seg = segment_ids is not None
 
-        def one_layer(layer_params, h):
+        def one_layer(layer_params, xtree):
+            h, pos = xtree[0], xtree[1]
+            seg = xtree[2] if has_seg else None
             out, _ = Block(cfg).apply(
-                {"params": layer_params}, h, pos_micro, None, train
+                {"params": layer_params}, h, pos, seg, train
             )
-            return out
+            return (out, pos) + ((seg,) if has_seg else ())
 
-        xs = x.reshape(n_micro, micro_b, S, D)
+        xs = (
+            x.reshape(n_micro, micro_b, S, D),
+            positions.reshape(n_micro, micro_b, S),
+        )
+        if has_seg:
+            xs = xs + (segment_ids.reshape(n_micro, micro_b, S),)
         ys = gpipe(one_layer, stacked, xs, mesh=mesh, axis="pipe")
-        return ys.reshape(B, S, D)
+        return ys[0].reshape(B, S, D)
 
 
 class TransformerLM(nn.Module):
@@ -394,7 +404,9 @@ class TransformerLM(nn.Module):
                 Block, static_argnums=(4,), prevent_cse=False
             )
         if cfg.pipeline_microbatches > 0:
-            x = PipelinedBlocks(cfg, name="pipeline")(x, positions, train)
+            x = PipelinedBlocks(cfg, name="pipeline")(
+                x, positions, segment_ids, train
+            )
             moe_aux = jnp.zeros((), jnp.float32)
         elif cfg.scan_layers:
             x, aux_per_layer = nn.scan(

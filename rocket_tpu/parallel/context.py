@@ -45,14 +45,45 @@ def current_rules() -> ShardingRules:
     return ctx[1] if ctx else DEFAULT_RULES
 
 
+def _manual_axes() -> frozenset:
+    """Mesh axes currently under manual (shard_map) control at trace time."""
+    am = jax.sharding.get_abstract_mesh()
+    if am is None or am.empty:
+        return frozenset()
+    return frozenset(am.manual_axes)
+
+
 def constrain(x: Any, *logical_axes: Optional[str]) -> Any:
     """Constrain an intermediate's sharding by logical axis names; identity
-    when no mesh context is active (single-device runs, plain tests)."""
+    when no mesh context is active (single-device runs, plain tests).
+
+    Inside a ``shard_map``-manual region (e.g. the GPipe stage program,
+    :func:`rocket_tpu.parallel.pipeline.gpipe`), mesh axes already under
+    manual control are stripped from the spec — ``with_sharding_constraint``
+    may only name non-manual axes there — degrading to identity when every
+    requested axis is manual.  This lets the same model code run sequential,
+    GSPMD-sharded, and pipelined without changes.
+    """
     ctx = _ACTIVE.get()
     if ctx is None:
         return x
     mesh, rules = ctx
     if mesh.devices.size == 1:
         return x
-    sharding = NamedSharding(mesh, rules.spec(*logical_axes))
+    spec = rules.spec(*logical_axes)
+    manual = _manual_axes()
+    if manual:
+        entries = []
+        for entry in spec:
+            if entry is None:
+                entries.append(None)
+            elif isinstance(entry, tuple):
+                kept = tuple(a for a in entry if a not in manual)
+                entries.append(kept if kept else None)
+            else:
+                entries.append(entry if entry not in manual else None)
+        if all(e is None for e in entries):
+            return x
+        spec = type(spec)(*entries)
+    sharding = NamedSharding(mesh, spec)
     return jax.lax.with_sharding_constraint(x, sharding)

@@ -32,44 +32,63 @@ from jax.sharding import Mesh, PartitionSpec as P
 Carry = Any
 
 
-def _chunk_apply(fn: Callable, local_params: Any, x: Any) -> Any:
+def _chunk_apply(fn: Callable, local_params: Any, x: Any, consts: tuple = ()) -> Any:
     """Apply this stage's stack of layers (leading dim = local layers)."""
 
     def body(carry, layer_params):
-        return fn(layer_params, carry), None
+        return fn(layer_params, carry, *consts), None
 
     out, _ = jax.lax.scan(body, x, local_params)
     return out
 
 
 def gpipe(
-    fn: Callable[[Any, Any], Any],
+    fn: Callable[..., Any],
     stacked_params: Any,
-    xs: jax.Array,
+    xs: Any,
     mesh: Mesh,
     axis: str = "pipe",
-    xs_spec: Optional[P] = None,
-) -> jax.Array:
+    xs_spec: Optional[Any] = None,
+    consts: tuple = (),
+) -> Any:
     """Run ``xs`` (microbatched on dim 0) through layer-stacked params,
     pipelined over ``mesh`` axis ``axis``.
 
     Parameters
     ----------
     fn:
-        ``fn(one_layer_params, x) -> x`` — a single layer.
+        ``fn(one_layer_params, x, *consts) -> x`` — a single layer.  ``x``
+        may be a pytree (e.g. ``(hidden, positions, segment_ids)``); ``fn``
+        must return the SAME structure — side inputs that attention needs
+        per-microbatch (position ids, segment ids) ride the pipeline
+        rotation with the activation and pass through each layer unchanged.
     stacked_params:
         pytree whose leaves have a leading layer dim ``L`` with
         ``L % P == 0`` (``P`` = size of the pipe axis).
     xs:
-        ``[n_micro, micro_batch, ...]`` microbatched input.
+        pytree of ``[n_micro, micro_batch, ...]`` microbatched arrays (a
+        bare array is the single-leaf case).
     xs_spec:
-        PartitionSpec for dims ``1:`` of ``xs``/output (e.g. batch sharded
-        over data axes); default fully replicated.
+        PartitionSpec for dims ``1:`` of each ``xs`` leaf/output (e.g.
+        batch sharded over data axes); a single spec applies to every leaf;
+        default fully replicated.
+    consts:
+        extra microbatch-invariant arrays threaded to every ``fn`` call.
+        Passed as explicit replicated shard_map arguments — closing over
+        traced values from the outer (auto) mesh context inside the manual
+        stage program is not allowed.
 
-    Returns ``ys`` with the same shape/sharding as ``xs``.
+    Returns ``ys`` with the same structure/shape/sharding as ``xs``.
     """
     n_stages = mesh.shape[axis]
-    n_micro = xs.shape[0]
+    xs_leaves = jax.tree_util.tree_leaves(xs)
+    n_micro = xs_leaves[0].shape[0]
+    for leaf in xs_leaves:
+        if leaf.shape[0] != n_micro:
+            raise ValueError(
+                f"xs leaves disagree on microbatch count: {leaf.shape[0]} "
+                f"vs {n_micro}"
+            )
     for leaf in jax.tree_util.tree_leaves(stacked_params):
         if leaf.shape[0] % n_stages != 0:
             raise ValueError(
@@ -77,49 +96,72 @@ def gpipe(
                 f"pipeline stages"
             )
     if n_stages == 1:
-        return _chunk_apply(fn, stacked_params, xs)
+        # Degraded single-stage path: still apply per microbatch — fn sees
+        # one [micro_batch, ...] slice at a time, exactly as in the
+        # pipelined schedule.
+        return jax.lax.map(
+            lambda x: _chunk_apply(fn, stacked_params, x, consts), xs
+        )
 
     inner = xs_spec if xs_spec is not None else P()
-    xs_full_spec = P(None, *inner)
+    xs_full_spec = jax.tree_util.tree_map(lambda _: P(None, *inner), xs)
     param_spec = jax.tree_util.tree_map(
         lambda leaf: P(axis, *([None] * (leaf.ndim - 1))), stacked_params
     )
+    const_spec = jax.tree_util.tree_map(lambda _: P(), consts)
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
-    def stage_program(local_params, xs_local):
+    def stage_program(local_params, xs_local, consts_local):
         p = jax.lax.axis_index(axis)
         ticks = n_micro + n_stages - 1
+        tmap = jax.tree_util.tree_map
 
         def tick(carry, t):
             act, ys = carry
-            feed = xs_local[jnp.minimum(t, n_micro - 1)]
+            idx = jnp.minimum(t, n_micro - 1)
+            feed = tmap(lambda a: a[idx], xs_local)
             # stage 0 ingests microbatch t (zeros in the drain phase)
-            act = jnp.where(p == 0, jnp.where(t < n_micro, feed, 0.0), act)
-            y = _chunk_apply(fn, local_params, act)
+            ingest = (p == 0) & (t < n_micro)
+            act = tmap(
+                lambda f, a: jnp.where(ingest, f, jnp.where(p == 0, 0, a).astype(a.dtype)),
+                feed,
+                act,
+            )
+            y = _chunk_apply(fn, local_params, act, consts_local)
             # last stage emits microbatch t-(P-1) during the fill phase's end
             out_idx = t - (n_stages - 1)
-            updated = jax.lax.dynamic_update_index_in_dim(
-                ys, y, jnp.maximum(out_idx, 0), 0
+            emit = (p == n_stages - 1) & (out_idx >= 0)
+            ys = tmap(
+                lambda buf, yv: jnp.where(
+                    emit,
+                    jax.lax.dynamic_update_index_in_dim(
+                        buf, yv, jnp.maximum(out_idx, 0), 0
+                    ),
+                    buf,
+                ),
+                ys,
+                y,
             )
-            ys = jnp.where((p == n_stages - 1) & (out_idx >= 0), updated, ys)
-            act = jax.lax.ppermute(y, axis, perm)
+            act = tmap(lambda yv: jax.lax.ppermute(yv, axis, perm), y)
             return (act, ys), None
 
-        act0 = jnp.zeros_like(xs_local[0])
-        ys0 = jnp.zeros_like(xs_local)
-        (_, ys), _ = jax.lax.scan(
-            tick, (act0, ys0), jnp.arange(ticks)
-        )
+        act0 = tmap(lambda a: jnp.zeros_like(a[0]), xs_local)
+        ys0 = tmap(jnp.zeros_like, xs_local)
+        (_, ys), _ = jax.lax.scan(tick, (act0, ys0), jnp.arange(ticks))
         # only the last stage's buffer is the real output; replicate it
-        ys = jax.lax.psum(
-            jnp.where(p == n_stages - 1, ys, 0.0), axis
+        ys = tmap(
+            lambda buf: jax.lax.psum(
+                jnp.where(p == n_stages - 1, buf, 0).astype(buf.dtype),
+                axis,
+            ),
+            ys,
         )
         return ys
 
     return jax.shard_map(
         stage_program,
         mesh=mesh,
-        in_specs=(param_spec, xs_full_spec),
+        in_specs=(param_spec, xs_full_spec, const_spec),
         out_specs=xs_full_spec,
         check_vma=False,
-    )(stacked_params, xs)
+    )(stacked_params, xs, consts)
