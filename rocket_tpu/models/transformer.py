@@ -355,7 +355,10 @@ class PipelinedBlocks(nn.Module):
         )
         if has_seg:
             xs = xs + (segment_ids.reshape(n_micro, micro_b, S),)
-        ys = gpipe(one_layer, stacked, xs, mesh=mesh, axis="pipe")
+        # positions/segments are pass-through side inputs: emit only the
+        # hidden state (no output buffer or final all-reduce for them)
+        emit = (True,) + (False,) * (len(xs) - 1)
+        ys = gpipe(one_layer, stacked, xs, mesh=mesh, axis="pipe", emit=emit)
         return ys[0].reshape(B, S, D)
 
 

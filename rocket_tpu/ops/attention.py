@@ -103,17 +103,11 @@ def attend(
             block_q=block_q, block_k=block_k,
         )
     if impl == "ring":
-        if segment_ids is not None:
-            raise ValueError(
-                "attend(impl='ring') does not support segment_ids yet: the "
-                "ring schedule has no segment masking, so packed batches "
-                "would silently attend across document boundaries. Use "
-                "impl='flash' or 'dot' for packed sequences."
-            )
         from rocket_tpu.ops.ring import ring_attention
 
         return ring_attention(
-            q, k, v, causal=causal, scale=scale, seq_axis=seq_axis or "seq"
+            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
+            seq_axis=seq_axis or "seq"
         )
     raise ValueError(f"unknown attention impl {impl!r}")
 

@@ -15,6 +15,12 @@ neighboring ops).  Causal skipping: fully-masked K blocks are skipped with
 with a large negative constant (never ``-inf`` — ``exp(-inf - -inf)`` is
 NaN).
 
+Packed sequences: ``segment_ids`` adds a block mask (query and key must
+share a segment).  The q-side ids ride in the same lane-broadcast layout as
+the logsumexp (``[B, S, 128]``; the kernel reads lane 0) and the k-side ids
+in a sublane layout (``[B, 8, S]``; the kernel reads sublane 0), so both
+respect TPU tiling without reshapes inside the kernel.
+
 Falls back transparently (see :func:`flash_attention`) when shapes don't
 meet the tiling constraints or a CPU backend is active (interpret mode is
 used on CPU so the same tests cover the kernel logic everywhere).
@@ -39,13 +45,40 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _block_mask(causal: bool, has_seg: bool, qi, ki, sq_ref, sk_ref,
+                block_q: int, block_k: int):
+    """[bq, bk] boolean mask (True = attend) or None when unmasked."""
+    mask = None
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = q_pos >= k_pos
+    if has_seg:
+        sq = sq_ref[0][:, :1]  # [bq, 1] (lane-broadcast layout, lane 0)
+        sk = sk_ref[0][:1, :]  # [1, bk] (sublane layout, sublane 0)
+        seg = sq == sk
+        mask = seg if mask is None else mask & seg
+    return mask
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-                *, scale: float, causal: bool, block_q: int, block_k: int):
+def _fwd_kernel(*refs, scale: float, causal: bool, has_seg: bool,
+                block_q: int, block_k: int):
+    if has_seg:
+        q_ref, k_ref, v_ref, sq_ref, sk_ref = refs[:5]
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[5:]
+    else:
+        q_ref, k_ref, v_ref = refs[:3]
+        o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[3:]
+        sq_ref = sk_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -70,20 +103,15 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            mask = q_pos >= k_pos
+        mask = _block_mask(causal, has_seg, qi, ki, sq_ref, sk_ref,
+                           block_q, block_k)
+        if mask is not None:
             s = jnp.where(mask, s, MASK_VALUE)
         m_prev = m_ref[:, :1]  # [bq, 1]
         l_prev = l_ref[:, :1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)  # [bq, bk]
-        if causal:
+        if mask is not None:
             p = jnp.where(mask, p, 0.0)
         correction = jnp.exp(m_prev - m_new)  # [bq, 1]
         l_new = correction * l_prev + jnp.sum(p, axis=-1, keepdims=True)
@@ -106,23 +134,40 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
         )
 
 
-def _flash_fwd(q, k, v, causal: bool, scale: float,
+def _seg_specs(block_q: int, block_k: int, kv_order: bool = False):
+    """BlockSpecs for (q-side [B,S,128], k-side [B,8,S]) segment layouts."""
+    if kv_order:  # grid (B, H, ki, qi)
+        sq = pl.BlockSpec((1, block_q, 128), lambda b, h, ki, qi: (b, qi, 0))
+        sk = pl.BlockSpec((1, 8, block_k), lambda b, h, ki, qi: (b, 0, ki))
+    else:  # grid (B, H, qi, ki)
+        sq = pl.BlockSpec((1, block_q, 128), lambda b, h, qi, ki: (b, qi, 0))
+        sk = pl.BlockSpec((1, 8, block_k), lambda b, h, qi, ki: (b, 0, ki))
+    return [sq, sk]
+
+
+def _flash_fwd(q, k, v, sq, sk, causal: bool, scale: float,
                block_q: int, block_k: int):
     B, H, S, D = q.shape
+    has_seg = sq is not None
     nq, nk = S // block_q, S // block_k
     grid = (B, H, nq, nk)
     kernel = functools.partial(
-        _fwd_kernel, scale=scale, causal=causal,
+        _fwd_kernel, scale=scale, causal=causal, has_seg=has_seg,
         block_q=block_q, block_k=block_k,
     )
+    in_specs = [
+        pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+        pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
+    ]
+    operands = [q, k, v]
+    if has_seg:
+        in_specs += _seg_specs(block_q, block_k)
+        operands += [sq, sk]
     o, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-            pl.BlockSpec((1, 1, block_k, D), lambda b, h, qi, ki: (b, h, ki, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
             pl.BlockSpec(
@@ -139,7 +184,7 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*operands)
     return o, lse
 
 
@@ -148,9 +193,15 @@ def _flash_fwd(q, k, v, causal: bool, scale: float,
 # ---------------------------------------------------------------------------
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, scale: float, causal: bool,
+def _dq_kernel(*refs, scale: float, causal: bool, has_seg: bool,
                block_q: int, block_k: int):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         sq_ref, sk_ref, dq_ref, acc_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, acc_ref) = refs
+        sq_ref = sk_ref = None
     qi = pl.program_id(2)
     ki = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -176,14 +227,10 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         p = jnp.exp(s - lse)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        mask = _block_mask(causal, has_seg, qi, ki, sq_ref, sk_ref,
+                           block_q, block_k)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -199,9 +246,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0, 0] = acc_ref[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                causal: bool, block_q: int, block_k: int):
+def _dkv_kernel(*refs, scale: float, causal: bool, has_seg: bool,
+                block_q: int, block_k: int):
+    if has_seg:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         sq_ref, sk_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+        sq_ref = sk_ref = None
     ki = pl.program_id(2)
     qi = pl.program_id(3)
     nq = pl.num_programs(3)
@@ -228,14 +281,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk]
         p = jnp.exp(s - lse)
-        if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            p = jnp.where(q_pos >= k_pos, p, 0.0)
+        mask = _block_mask(causal, has_seg, qi, ki, sq_ref, sk_ref,
+                           block_q, block_k)
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         # dV += Pᵀ dO
         dv_acc[:] += jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -258,9 +307,10 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, o, lse, do, causal: bool, scale: float,
+def _flash_bwd(q, k, v, sq, sk, o, lse, do, causal: bool, scale: float,
                block_q: int, block_k: int):
     B, H, S, D = q.shape
+    has_seg = sq is not None
     nq, nk = S // block_q, S // block_k
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
@@ -273,9 +323,13 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, scale: float,
         pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
         pl.BlockSpec((1, 1, block_q, 128), lambda b, h, qi, ki: (b, h, qi, 0)),
     ]
+    operands = [q, k, v, do, lse, delta]
+    if has_seg:
+        common_in = common_in + _seg_specs(block_q, block_k)
+        operands = operands + [sq, sk]
     dq = pl.pallas_call(
         functools.partial(
-            _dq_kernel, scale=scale, causal=causal,
+            _dq_kernel, scale=scale, causal=causal, has_seg=has_seg,
             block_q=block_q, block_k=block_k,
         ),
         grid=(B, H, nq, nk),
@@ -286,7 +340,7 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, scale: float,
         out_shape=jax.ShapeDtypeStruct((B, H, S, D), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*operands)
 
     kv_in = [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, ki, qi: (b, h, qi, 0)),
@@ -296,9 +350,13 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, scale: float,
         pl.BlockSpec((1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)),
         pl.BlockSpec((1, 1, block_q, 128), lambda b, h, ki, qi: (b, h, qi, 0)),
     ]
+    kv_operands = [q, k, v, do, lse, delta]
+    if has_seg:
+        kv_in = kv_in + _seg_specs(block_q, block_k, kv_order=True)
+        kv_operands = kv_operands + [sq, sk]
     dk, dv = pl.pallas_call(
         functools.partial(
-            _dkv_kernel, scale=scale, causal=causal,
+            _dkv_kernel, scale=scale, causal=causal, has_seg=has_seg,
             block_q=block_q, block_k=block_k,
         ),
         grid=(B, H, nk, nq),
@@ -316,30 +374,36 @@ def _flash_bwd(q, k, v, o, lse, do, causal: bool, scale: float,
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse, delta)
+    )(*kv_operands)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
 # custom_vjp plumbing
 # ---------------------------------------------------------------------------
+# The segment-id layout arrays are float32 primals (custom_vjp wants array
+# args differentiable-typed; their cotangents are structural zeros).
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, scale, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _flash(q, k, v, sq, sk, causal, scale, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k)
     return o
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, causal, scale, block_q, block_k)
-    return o, (q, k, v, o, lse)
+def _flash_fwd_rule(q, k, v, sq, sk, causal, scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k)
+    return o, (q, k, v, sq, sk, o, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, g):
-    q, k, v, o, lse = res
-    dq, dk, dv = _flash_bwd(q, k, v, o, lse, g, causal, scale, block_q, block_k)
-    return dq, dk, dv
+    q, k, v, sq, sk, o, lse = res
+    dq, dk, dv = _flash_bwd(
+        q, k, v, sq, sk, o, lse, g, causal, scale, block_q, block_k
+    )
+    dsq = None if sq is None else jnp.zeros_like(sq)
+    dsk = None if sk is None else jnp.zeros_like(sk)
+    return dq, dk, dv, dsq, dsk
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -363,9 +427,11 @@ def flash_attention(
 ) -> jax.Array:
     """Flash attention on ``[B, S, H, D]`` (K/V may be GQA-grouped).
 
+    ``segment_ids`` (``[B, S]`` int) restricts attention to same-segment
+    pairs — packed multi-document batches keep the O(S) blocked kernel.
     Falls back to :func:`rocket_tpu.ops.attention.dot_attention` when the
-    kernel's constraints don't hold (segment_ids given, S not a multiple of
-    the block sizes, tiny head_dim).
+    kernel's tiling constraints don't hold (S not a multiple of the block
+    sizes, tiny head_dim).
     """
     from rocket_tpu.ops.attention import _repeat_kv, dot_attention
 
@@ -373,17 +439,18 @@ def flash_attention(
     scale = scale if scale is not None else D ** -0.5
     block_q = min(block_q, S)
     block_k = min(block_k, S)
-    if (
-        segment_ids is not None
-        or S % block_q != 0
-        or S % block_k != 0
-        or D % 8 != 0
-    ):
+    if S % block_q != 0 or S % block_k != 0 or D % 8 != 0:
         return dot_attention(
             q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
         )
     k, v = _repeat_kv(k, v, H)
+    if segment_ids is not None:
+        seg = segment_ids.astype(jnp.float32)
+        sq = jnp.broadcast_to(seg[:, :, None], (B, S, 128))
+        sk = jnp.broadcast_to(seg[:, None, :], (B, 8, S))
+    else:
+        sq = sk = None
     # [B, S, H, D] -> [B, H, S, D] for the kernel
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-    o = _flash(qt, kt, vt, causal, scale, block_q, block_k)
+    o = _flash(qt, kt, vt, sq, sk, causal, scale, block_q, block_k)
     return o.swapaxes(1, 2)

@@ -36,23 +36,31 @@ from rocket_tpu.parallel.mesh import DATA_AXES
 MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
 
 
-def _local_block(q, k, v, q_start, k_start, scale, causal):
+def _local_block(q, k, v, q_start, k_start, scale, causal,
+                 seg_q=None, seg_k=None):
     """One (q_chunk x k_chunk) online-softmax block.
 
-    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; returns (s_max, p_sum, pv) pieces
-    used by the ring merge. Positions are global offsets for causal masking.
+    q: [B, Sq, H, D]; k/v: [B, Sk, H, D]; seg_q/seg_k: [B, Sq]/[B, Sk]
+    segment ids (packed sequences — queries attend within their segment
+    only).  Positions are global offsets for causal masking.  Returns the
+    masked scores and the boolean mask (None when unmasked).
     """
     s = jnp.einsum(
         "bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32
     ) * scale
+    mask = None
     if causal:
         Sq, Sk = q.shape[1], k.shape[1]
         q_pos = q_start + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 0)
         k_pos = k_start + lax.broadcasted_iota(jnp.int32, (Sq, Sk), 1)
-        mask = (q_pos >= k_pos)[None, None]
+        mask = jnp.broadcast_to((q_pos >= k_pos)[None, None], s.shape)
+    if seg_q is not None:
+        seg = (seg_q[:, :, None] == seg_k[:, None, :])[:, None]  # [B,1,Sq,Sk]
+        seg = jnp.broadcast_to(seg, s.shape)
+        mask = seg if mask is None else mask & seg
+    if mask is not None:
         s = jnp.where(mask, s, MASK_VALUE)
-        return s, mask
-    return s, None
+    return s, mask
 
 
 def ring_attention(
@@ -61,13 +69,18 @@ def ring_attention(
     v: jax.Array,
     *,
     causal: bool = True,
+    segment_ids: Optional[jax.Array] = None,
     scale: Optional[float] = None,
     seq_axis: str = "seq",
 ) -> jax.Array:
     """Ring attention on ``[B, S, H, D]`` inputs sharded over ``seq_axis``.
 
-    Must be called under a mesh context (the Module opens one around apply);
-    degrades to plain dot attention when the ``seq`` axis is trivial.
+    ``segment_ids`` (``[B, S]``, sharded over ``seq_axis`` like Q/K/V)
+    restricts attention to same-segment pairs: the k-side ids rotate around
+    the ring with their K/V chunk, so packed multi-document batches work at
+    ring scale.  Must be called under a mesh context (the Module opens one
+    around apply); degrades to plain dot attention when the ``seq`` axis is
+    trivial.
     """
     from rocket_tpu.ops.attention import _repeat_kv, dot_attention
     from rocket_tpu.parallel.context import current_mesh
@@ -76,31 +89,43 @@ def ring_attention(
     scale = scale if scale is not None else D ** -0.5
     mesh = current_mesh()
     if mesh is None or mesh.shape.get(seq_axis, 1) == 1:
-        return dot_attention(q, k, v, causal=causal, scale=scale)
+        return dot_attention(
+            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
+        )
     k, v = _repeat_kv(k, v, H)
     n = mesh.shape[seq_axis]
 
     spec = P(DATA_AXES, seq_axis, None, None)
+    seg_spec = P(DATA_AXES, seq_axis)
+    has_seg = segment_ids is not None
+    operands = (q, k, v) + ((segment_ids,) if has_seg else ())
+    in_specs = (spec, spec, spec) + ((seg_spec,) if has_seg else ())
 
     @functools.partial(
         jax.shard_map,
         mesh=mesh,
-        in_specs=(spec, spec, spec),
+        in_specs=in_specs,
         out_specs=spec,
         check_vma=False,
     )
-    def ring(ql, kl, vl):
-        # ql/kl/vl: local chunks [b, S/n, H, D]
+    def ring(ql, kl, vl, *rest):
+        # ql/kl/vl: local chunks [b, S/n, H, D]; rest: ([b, S/n] seg ids,)
+        segl = rest[0] if has_seg else None
         chunk = ql.shape[1]
         my = lax.axis_index(seq_axis)
         q_start = my * chunk
         perm = [(i, (i + 1) % n) for i in range(n)]
 
         def step(i, carry):
-            acc, m, l, k_cur, v_cur = carry
+            if has_seg:
+                acc, m, l, k_cur, v_cur, seg_cur = carry
+            else:
+                acc, m, l, k_cur, v_cur = carry
+                seg_cur = None
             src = (my - i) % n  # whose chunk we currently hold
             s, mask = _local_block(
-                ql, k_cur, v_cur, q_start, src * chunk, scale, causal
+                ql, k_cur, v_cur, q_start, src * chunk, scale, causal,
+                seg_q=segl, seg_k=seg_cur,
             )
             m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
             p = jnp.exp(s - m_new)
@@ -113,25 +138,27 @@ def ring_attention(
                 preferred_element_type=jnp.float32,
             )
             acc = acc * correction.transpose(0, 2, 1, 3) + pv
-            # rotate K/V to the next device; skipped on the last step
-            k_nxt, v_nxt = lax.cond(
+            # rotate K/V (+ segment ids when packed) to the next device;
+            # skipped on the last step
+            rot = (k_cur, v_cur) + ((seg_cur,) if has_seg else ())
+            rot = lax.cond(
                 i < n - 1,
-                lambda kv: tuple(
-                    lax.ppermute(x, seq_axis, perm) for x in kv
+                lambda kvs: tuple(
+                    lax.ppermute(x, seq_axis, perm) for x in kvs
                 ),
-                lambda kv: kv,
-                (k_cur, v_cur),
+                lambda kvs: kvs,
+                rot,
             )
-            return acc, m_new, l, k_nxt, v_nxt
+            return (acc, m_new, l) + rot
 
         b, sq = ql.shape[0], ql.shape[1]
         acc0 = jnp.zeros((b, sq, H, D), jnp.float32)
         m0 = jnp.full((b, H, sq, 1), MASK_VALUE, jnp.float32)
         l0 = jnp.zeros((b, H, sq, 1), jnp.float32)
-        acc, m, l, _, _ = lax.fori_loop(
-            0, n, step, (acc0, m0, l0, kl, vl)
-        )
+        init = (acc0, m0, l0, kl, vl) + ((segl,) if has_seg else ())
+        out = lax.fori_loop(0, n, step, init)
+        acc, m, l = out[0], out[1], out[2]
         safe_l = jnp.where(l == 0.0, 1.0, l).transpose(0, 2, 1, 3)
         return (acc / safe_l).astype(ql.dtype)
 
-    return ring(q, k, v)
+    return ring(*operands)

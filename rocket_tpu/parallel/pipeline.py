@@ -50,6 +50,7 @@ def gpipe(
     axis: str = "pipe",
     xs_spec: Optional[Any] = None,
     consts: tuple = (),
+    emit: Optional[Any] = None,
 ) -> Any:
     """Run ``xs`` (microbatched on dim 0) through layer-stacked params,
     pipelined over ``mesh`` axis ``axis``.
@@ -70,18 +71,24 @@ def gpipe(
         bare array is the single-leaf case).
     xs_spec:
         PartitionSpec for dims ``1:`` of each ``xs`` leaf/output (e.g.
-        batch sharded over data axes); a single spec applies to every leaf;
-        default fully replicated.
+        batch sharded over data axes); default fully replicated.  When
+        ``xs`` has leaves of different ranks, pass a matching pytree of
+        specs instead of a single spec.
     consts:
         extra microbatch-invariant arrays threaded to every ``fn`` call.
         Passed as explicit replicated shard_map arguments — closing over
         traced values from the outer (auto) mesh context inside the manual
         stage program is not allowed.
+    emit:
+        optional pytree of bools matching ``xs``: leaves marked False are
+        pure pass-through side inputs — no output buffer is accumulated
+        and no final all-reduce is paid for them; their slot in the result
+        is ``None``.  Default: emit every leaf.
 
-    Returns ``ys`` with the same structure/shape/sharding as ``xs``.
+    Returns ``ys`` with the structure of ``xs`` (non-emitted leaves None).
     """
     n_stages = mesh.shape[axis]
-    xs_leaves = jax.tree_util.tree_leaves(xs)
+    xs_leaves, treedef = jax.tree_util.tree_flatten(xs)
     n_micro = xs_leaves[0].shape[0]
     for leaf in xs_leaves:
         if leaf.shape[0] != n_micro:
@@ -95,16 +102,51 @@ def gpipe(
                 f"layer dim {leaf.shape[0]} not divisible by {n_stages} "
                 f"pipeline stages"
             )
+    if emit is None:
+        emit_flags = [True] * len(xs_leaves)
+    else:
+        emit_flags = jax.tree_util.tree_leaves(emit)
+        if len(emit_flags) != len(xs_leaves):
+            raise ValueError(
+                f"emit has {len(emit_flags)} leaves, xs has {len(xs_leaves)}"
+            )
+    if not any(emit_flags):
+        raise ValueError("emit must keep at least one output leaf")
+
+    def _mask_outputs(ys):
+        leaves = jax.tree_util.tree_leaves(ys)
+        return treedef.unflatten(
+            [y if e else None for y, e in zip(leaves, emit_flags)]
+        )
+
     if n_stages == 1:
         # Degraded single-stage path: still apply per microbatch — fn sees
         # one [micro_batch, ...] slice at a time, exactly as in the
         # pipelined schedule.
-        return jax.lax.map(
+        return _mask_outputs(jax.lax.map(
             lambda x: _chunk_apply(fn, stacked_params, x, consts), xs
-        )
+        ))
 
-    inner = xs_spec if xs_spec is not None else P()
-    xs_full_spec = jax.tree_util.tree_map(lambda _: P(None, *inner), xs)
+    is_spec = lambda s: isinstance(s, P)  # noqa: E731
+    if xs_spec is None:
+        inner_specs = [P()] * len(xs_leaves)
+    elif is_spec(xs_spec):
+        if len({leaf.ndim for leaf in xs_leaves}) > 1 and len(xs_spec) > 0:
+            raise ValueError(
+                "xs has leaves of different ranks; pass xs_spec as a "
+                "matching pytree of PartitionSpecs, not one spec"
+            )
+        inner_specs = [xs_spec] * len(xs_leaves)
+    else:
+        inner_specs = jax.tree_util.tree_leaves(xs_spec, is_leaf=is_spec)
+        if len(inner_specs) != len(xs_leaves):
+            raise ValueError(
+                f"xs_spec has {len(inner_specs)} specs, xs has "
+                f"{len(xs_leaves)} leaves"
+            )
+    full_specs = [P(None, *s) for s in inner_specs]
+    xs_full_spec = treedef.unflatten(full_specs)
+    out_spec = tuple(s for s, e in zip(full_specs, emit_flags) if e)
     param_spec = jax.tree_util.tree_map(
         lambda leaf: P(axis, *([None] * (leaf.ndim - 1))), stacked_params
     )
@@ -116,6 +158,12 @@ def gpipe(
         ticks = n_micro + n_stages - 1
         tmap = jax.tree_util.tree_map
 
+        def emitted(tree):
+            return tuple(
+                leaf for leaf, e
+                in zip(jax.tree_util.tree_leaves(tree), emit_flags) if e
+            )
+
         def tick(carry, t):
             act, ys = carry
             idx = jnp.minimum(t, n_micro - 1)
@@ -123,45 +171,48 @@ def gpipe(
             # stage 0 ingests microbatch t (zeros in the drain phase)
             ingest = (p == 0) & (t < n_micro)
             act = tmap(
-                lambda f, a: jnp.where(ingest, f, jnp.where(p == 0, 0, a).astype(a.dtype)),
+                lambda f, a: jnp.where(
+                    ingest, f, jnp.where(p == 0, 0, a).astype(a.dtype)
+                ),
                 feed,
                 act,
             )
             y = _chunk_apply(fn, local_params, act, consts_local)
             # last stage emits microbatch t-(P-1) during the fill phase's end
             out_idx = t - (n_stages - 1)
-            emit = (p == n_stages - 1) & (out_idx >= 0)
-            ys = tmap(
-                lambda buf, yv: jnp.where(
-                    emit,
+            do_emit = (p == n_stages - 1) & (out_idx >= 0)
+            ys = tuple(
+                jnp.where(
+                    do_emit,
                     jax.lax.dynamic_update_index_in_dim(
                         buf, yv, jnp.maximum(out_idx, 0), 0
                     ),
                     buf,
-                ),
-                ys,
-                y,
+                )
+                for buf, yv in zip(ys, emitted(y))
             )
             act = tmap(lambda yv: jax.lax.ppermute(yv, axis, perm), y)
             return (act, ys), None
 
         act0 = tmap(lambda a: jnp.zeros_like(a[0]), xs_local)
-        ys0 = tmap(jnp.zeros_like, xs_local)
+        ys0 = tuple(jnp.zeros_like(leaf) for leaf in emitted(xs_local))
         (_, ys), _ = jax.lax.scan(tick, (act0, ys0), jnp.arange(ticks))
         # only the last stage's buffer is the real output; replicate it
-        ys = tmap(
-            lambda buf: jax.lax.psum(
-                jnp.where(p == n_stages - 1, buf, 0).astype(buf.dtype),
-                axis,
-            ),
-            ys,
+        return tuple(
+            jax.lax.psum(
+                jnp.where(p == n_stages - 1, buf, 0).astype(buf.dtype), axis
+            )
+            for buf in ys
         )
-        return ys
 
-    return jax.shard_map(
+    ys_out = jax.shard_map(
         stage_program,
         mesh=mesh,
         in_specs=(param_spec, xs_full_spec, const_spec),
-        out_specs=xs_full_spec,
+        out_specs=out_spec,
         check_vma=False,
     )(stacked_params, xs, consts)
+    it = iter(ys_out)
+    return treedef.unflatten(
+        [next(it) if e else None for e in emit_flags]
+    )
