@@ -145,10 +145,24 @@ def _seg_specs(block_q: int, block_k: int, kv_order: bool = False):
     return [sq, sk]
 
 
-def _flash_fwd(q, k, v, sq, sk, causal: bool, scale: float,
+def _seg_layouts(seg):
+    """Expand compact ``[B, S]`` f32 segment ids into the kernel layouts:
+    q-side lane-broadcast ``[B, S, 128]`` and k-side sublane ``[B, 8, S]``.
+    Built just before each pallas_call so only the compact form is ever a
+    custom_vjp residual."""
+    if seg is None:
+        return None, None
+    B, S = seg.shape
+    sq = jnp.broadcast_to(seg[:, :, None], (B, S, 128))
+    sk = jnp.broadcast_to(seg[:, None, :], (B, 8, S))
+    return sq, sk
+
+
+def _flash_fwd(q, k, v, seg, causal: bool, scale: float,
                block_q: int, block_k: int):
     B, H, S, D = q.shape
-    has_seg = sq is not None
+    has_seg = seg is not None
+    sq, sk = _seg_layouts(seg)
     nq, nk = S // block_q, S // block_k
     grid = (B, H, nq, nk)
     kernel = functools.partial(
@@ -307,10 +321,11 @@ def _dkv_kernel(*refs, scale: float, causal: bool, has_seg: bool,
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(q, k, v, sq, sk, o, lse, do, causal: bool, scale: float,
+def _flash_bwd(q, k, v, seg, o, lse, do, causal: bool, scale: float,
                block_q: int, block_k: int):
     B, H, S, D = q.shape
-    has_seg = sq is not None
+    has_seg = seg is not None
+    sq, sk = _seg_layouts(seg)
     nq, nk = S // block_q, S // block_k
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     delta = jnp.broadcast_to(delta[..., None], (*delta.shape, 128))
@@ -381,29 +396,30 @@ def _flash_bwd(q, k, v, sq, sk, o, lse, do, causal: bool, scale: float,
 # ---------------------------------------------------------------------------
 # custom_vjp plumbing
 # ---------------------------------------------------------------------------
-# The segment-id layout arrays are float32 primals (custom_vjp wants array
-# args differentiable-typed; their cotangents are structural zeros).
+# The compact [B, S] f32 segment ids are a primal arg (custom_vjp wants
+# array args differentiable-typed; the cotangent is a structural zero); the
+# 128x lane/sublane kernel layouts are built inside each rule so they are
+# never held as fwd->bwd residuals.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
-def _flash(q, k, v, sq, sk, causal, scale, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def _flash(q, k, v, seg, causal, scale, block_q, block_k):
+    o, _ = _flash_fwd(q, k, v, seg, causal, scale, block_q, block_k)
     return o
 
 
-def _flash_fwd_rule(q, k, v, sq, sk, causal, scale, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, sq, sk, causal, scale, block_q, block_k)
-    return o, (q, k, v, sq, sk, o, lse)
+def _flash_fwd_rule(q, k, v, seg, causal, scale, block_q, block_k):
+    o, lse = _flash_fwd(q, k, v, seg, causal, scale, block_q, block_k)
+    return o, (q, k, v, seg, o, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, g):
-    q, k, v, sq, sk, o, lse = res
+    q, k, v, seg, o, lse = res
     dq, dk, dv = _flash_bwd(
-        q, k, v, sq, sk, o, lse, g, causal, scale, block_q, block_k
+        q, k, v, seg, o, lse, g, causal, scale, block_q, block_k
     )
-    dsq = None if sq is None else jnp.zeros_like(sq)
-    dsk = None if sk is None else jnp.zeros_like(sk)
-    return dq, dk, dv, dsq, dsk
+    dseg = None if seg is None else jnp.zeros_like(seg)
+    return dq, dk, dv, dseg
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -444,13 +460,8 @@ def flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
         )
     k, v = _repeat_kv(k, v, H)
-    if segment_ids is not None:
-        seg = segment_ids.astype(jnp.float32)
-        sq = jnp.broadcast_to(seg[:, :, None], (B, S, 128))
-        sk = jnp.broadcast_to(seg[:, None, :], (B, 8, S))
-    else:
-        sq = sk = None
+    seg = None if segment_ids is None else segment_ids.astype(jnp.float32)
     # [B, S, H, D] -> [B, H, S, D] for the kernel
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-    o = _flash(qt, kt, vt, sq, sk, causal, scale, block_q, block_k)
+    o = _flash(qt, kt, vt, seg, causal, scale, block_q, block_k)
     return o.swapaxes(1, 2)

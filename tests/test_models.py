@@ -289,6 +289,73 @@ def test_moe_all_tokens_routed_with_ample_capacity(devices):
     assert 0.0 < float(aux) < 4.0
 
 
+def test_moe_sort_dispatch_matches_onehot_oracle(devices):
+    """The scalable sort/scatter dispatch and the GShard one-hot einsum
+    oracle produce the same outputs AND the same gradients — the seat
+    assignment (slot-major, overflow dropping) is semantically identical
+    (VERDICT r2 weak #6)."""
+    import jax.numpy as jnp
+
+    from rocket_tpu.models.moe import MoEMLP
+
+    kw = dict(n_experts=8, mlp_dim=32, top_k=2, capacity_factor=1.0)
+    sort_layer = MoEMLP(**kw, dispatch="sort")
+    onehot_layer = MoEMLP(**kw, dispatch="onehot")
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(2, 64, 16)), jnp.float32
+    )
+    variables = sort_layer.init(jax.random.PRNGKey(0), x)
+
+    y_sort, aux_sort = sort_layer.apply(variables, x)
+    y_hot, aux_hot = onehot_layer.apply(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(y_sort), np.asarray(y_hot), atol=1e-5, rtol=1e-5
+    )
+    np.testing.assert_allclose(float(aux_sort), float(aux_hot), rtol=1e-6)
+
+    def loss(params, layer):
+        y, aux = layer.apply(params, x)
+        return jnp.sum(y ** 2) + aux
+
+    g_sort = jax.grad(loss)(variables, sort_layer)
+    g_hot = jax.grad(loss)(variables, onehot_layer)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=2e-5, rtol=2e-4
+        ),
+        g_sort,
+        g_hot,
+    )
+
+
+def test_moe_sort_dispatch_memory_scales(devices):
+    """At E=32 the one-hot path materializes O(B*S*E*C) dispatch/combine
+    tensors; the sort path must stay well under that (the point of the
+    rewrite).  Compared via XLA's compiled temp-memory analysis."""
+    import jax.numpy as jnp
+
+    from rocket_tpu.models.moe import MoEMLP
+
+    kw = dict(n_experts=32, mlp_dim=64, top_k=2, capacity_factor=1.25)
+    B, S, D = 4, 512, 32
+    x = jnp.asarray(
+        np.random.default_rng(0).normal(size=(B, S, D)), jnp.float32
+    )
+
+    def temp_bytes(layer):
+        variables = layer.init(jax.random.PRNGKey(0), x)
+        fn = jax.jit(lambda v, xx: layer.apply(v, xx)[0])
+        mem = fn.lower(variables, x).compile().memory_analysis()
+        return int(mem.temp_size_in_bytes)
+
+    sort_bytes = temp_bytes(MoEMLP(**kw, dispatch="sort"))
+    onehot_bytes = temp_bytes(MoEMLP(**kw, dispatch="onehot"))
+    # one-hot: combine+dispatch are B*S*E*C*4 bytes each (C=40 here ->
+    # ~10MB per tensor); sort path carries only [B,K*S] routing vectors
+    # and the [E,C,D] buffers both paths share.
+    assert sort_bytes < onehot_bytes / 2, (sort_bytes, onehot_bytes)
+
+
 def test_lora_freezes_base_weights(devices):
     runtime = rt.Runtime()
     cfg = TransformerConfig.tiny(lora_rank=4)
