@@ -3,9 +3,13 @@
 Demonstrates the LM pipeline: grad accumulation, cosine LR schedule with
 warmup, gradient clipping, checkpoint + resume, flash attention.  Data is a
 token file if given (``--data tokens.npy``: int32 ``[docs, seq]``), else a
-synthetic Markov stream so the script runs anywhere.
+synthetic Markov stream so the script runs anywhere.  With ``--stream`` the
+token file is consumed as a length-free iterator (OpenWebText-style
+streaming; reference parity: torch IterableDataset through the loader,
+``rocket/core/dataset.py:100-126``) — resume still works because the
+stream replays deterministically.
 
-    python examples/train_gpt2.py [--tiny] [--resume path/to/ckpt]
+    python examples/train_gpt2.py [--tiny] [--stream] [--resume path/to/ckpt]
 """
 
 import argparse
@@ -27,6 +31,10 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--tiny", action="store_true", help="tiny config (CPU-friendly)")
     parser.add_argument("--data", type=str, default=None, help="int32 [docs, seq] .npy")
+    parser.add_argument(
+        "--stream", action="store_true",
+        help="consume tokens as a length-free stream (IterableSource)",
+    )
     parser.add_argument("--resume", type=str, default=None)
     parser.add_argument("--epochs", type=int, default=2)
     parser.add_argument("--batch", type=int, default=8)
@@ -63,13 +71,24 @@ def main():
             rt.Scheduler(schedule),
         ],
     )
+    if args.stream:
+        # Length-free streaming: rows leave the token store one at a time
+        # (stand-in for an OpenWebText shard reader); the loader shards the
+        # stream per host and shuffles through a seeded buffer.
+        tokens = data["tokens"]
+
+        def row_stream():
+            for row in tokens:
+                yield {"tokens": row}
+
+        source = rt.GeneratorSource(row_stream)
+    else:
+        source = rt.ArraySource(data)
     launcher = rt.Launcher(
         capsules=[
             rt.Looper(
                 capsules=[
-                    rt.Dataset(
-                        rt.ArraySource(data), batch_size=args.batch, shuffle=True
-                    ),
+                    rt.Dataset(source, batch_size=args.batch, shuffle=True),
                     model,
                     rt.Tracker("jsonl"),
                     rt.Checkpointer(save_every=50, keep_last=2),

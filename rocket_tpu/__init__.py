@@ -19,7 +19,13 @@ from rocket_tpu.core import (
     Optimizer,
     Scheduler,
 )
-from rocket_tpu.data import ArraySource, DataLoader, Dataset
+from rocket_tpu.data import (
+    ArraySource,
+    DataLoader,
+    Dataset,
+    GeneratorSource,
+    IterableSource,
+)
 from rocket_tpu.launch import Launcher, Looper
 from rocket_tpu.observe import Accuracy, ImageLogger, Meter, Metric, StatMetric, Tracker
 from rocket_tpu.persist import Checkpointer
@@ -36,6 +42,8 @@ __all__ = [
     "Dataset",
     "Dispatcher",
     "Events",
+    "GeneratorSource",
+    "IterableSource",
     "Launcher",
     "Looper",
     "Loss",

@@ -1,12 +1,21 @@
 from rocket_tpu.data.dataset import Dataset
 from rocket_tpu.data.loader import DataLoader
-from rocket_tpu.data.source import ArraySource, ConcatSource, MapSource, Source
+from rocket_tpu.data.source import (
+    ArraySource,
+    ConcatSource,
+    GeneratorSource,
+    IterableSource,
+    MapSource,
+    Source,
+)
 
 __all__ = [
     "ArraySource",
     "ConcatSource",
     "DataLoader",
     "Dataset",
+    "GeneratorSource",
+    "IterableSource",
     "MapSource",
     "Source",
 ]

@@ -41,6 +41,7 @@ class Dataset(Capsule):
         drop_last: bool = False,
         collate_fn: Optional[Callable] = None,
         prefetch: int = 2,
+        shuffle_buffer: int = 1024,
         loader: Optional[DataLoader] = None,
         statefull: bool = True,
         priority: int = 1000,
@@ -58,6 +59,7 @@ class Dataset(Capsule):
             drop_last=drop_last,
             collate_fn=collate_fn,
             prefetch=prefetch,
+            shuffle_buffer=shuffle_buffer,
         )
         self._iterator = None
         self._total: Optional[int] = None
@@ -76,7 +78,7 @@ class Dataset(Capsule):
         elif self._loader.sharding is None:
             self._loader.sharding = self._runtime.batch_sharding(ndim=1)
         self._runtime.register_unique("dataset", self._loader)
-        self._total = len(self._loader)
+        self._total = self._loader.num_batches  # None for streaming sources
 
     def destroy(self, attrs: Optional[Attributes] = None) -> None:
         # Deregister BEFORE dropping the reference (fixes reference bug,

@@ -21,6 +21,13 @@ with a TPU-first design:
 - **Prefetch double-buffering**: a background thread stages collated host
   batches; device transfer is issued ahead so H2D rides under compute
   (replaces torch pin-memory workers, SURVEY §2.1).
+- **Streaming sources**: a length-free :class:`~rocket_tpu.data.source.
+  IterableSource` streams through the same pipeline (reference parity:
+  torch ``IterableDataset`` passes straight through ``dataset.py:100-126``).
+  Every process scans the common stream and keeps rows ``i % procs == p``
+  (per-host round-robin), an optional seeded shuffle buffer reorders
+  globally-consistently, and mid-epoch resume skips ``k`` batches by
+  replaying the stream — deterministic because the stream itself is.
 """
 
 from __future__ import annotations
@@ -40,13 +47,20 @@ class DataLoader:
     """Parameters
     ----------
     source:
-        Map-style source (``__len__`` + ``__getitem__``).
+        Map-style source (``__len__`` + ``__getitem__``) or a length-free
+        iterable source (``__iter__``; see
+        :class:`~rocket_tpu.data.source.IterableSource`).
     batch_size:
         **Global** batch size (across all hosts/devices).
     shuffle / seed:
-        Seeded epoch permutation; order is reproducible across restarts.
+        Map-style: seeded epoch permutation.  Streaming: seeded shuffle
+        buffer of ``shuffle_buffer`` samples.  Reproducible across
+        restarts either way.
     drop_last:
         Drop the trailing partial batch instead of pad+mask.
+    shuffle_buffer:
+        Streaming only: size of the shuffle buffer (ignored for map-style
+        sources).
     collate_fn:
         Sample-list -> batch pytree (default stacks arrays, passes the rest
         through as lists — reference ``torch_collate`` semantics).
@@ -68,6 +82,7 @@ class DataLoader:
         sharding: Optional[Any] = None,
         prefetch: int = 2,
         mask_key: str = "_valid",
+        shuffle_buffer: int = 1024,
     ) -> None:
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
@@ -80,7 +95,14 @@ class DataLoader:
         self.sharding = sharding
         self.prefetch = int(prefetch)
         self.mask_key = mask_key
+        self.shuffle_buffer = int(shuffle_buffer)
         self.epoch = 0
+        self.streaming = not hasattr(source, "__len__")
+        if self.streaming and not hasattr(source, "__iter__"):
+            raise TypeError(
+                f"source {type(source).__name__} is neither map-style "
+                f"(__len__ + __getitem__) nor iterable (__iter__)"
+            )
 
         procs = jax.process_count()
         if self.batch_size % procs != 0:
@@ -93,10 +115,20 @@ class DataLoader:
     # -- length -------------------------------------------------------------
 
     def __len__(self) -> int:
+        if self.streaming:
+            raise TypeError(
+                "streaming DataLoader has no length; use num_batches (None)"
+            )
         n = len(self.source)
         if self.drop_last:
             return n // self.batch_size
         return (n + self.batch_size - 1) // self.batch_size
+
+    @property
+    def num_batches(self) -> Optional[int]:
+        """Batches per epoch; ``None`` when the source is a length-free
+        stream."""
+        return None if self.streaming else len(self)
 
     # -- index plan ---------------------------------------------------------
 
@@ -132,12 +164,7 @@ class DataLoader:
         lo = p * self.local_batch_size
         hi = lo + self.local_batch_size
         samples = [self.source[int(i)] for i in idx[lo:hi]]
-        batch = self.collate_fn(samples)
-        if not isinstance(batch, (dict, Attributes)):
-            batch = Attributes(data=batch)
-        batch = Attributes(batch)
-        batch[self.mask_key] = valid[lo:hi]
-        return batch
+        return self._collate_local(samples, valid[lo:hi])
 
     def _to_device(self, host_batch: Any) -> Any:
         if self.sharding is None:
@@ -158,33 +185,127 @@ class DataLoader:
 
         return jax.tree_util.tree_map(place, host_batch)
 
+    # -- streaming host batches ---------------------------------------------
+
+    def _stream_shuffled(self, epoch: int) -> Iterator[Any]:
+        """The global stream, optionally reordered through a seeded shuffle
+        buffer.  Every process runs this identically (determinism is what
+        makes the per-host modulo split below correct)."""
+        it = (
+            self.source.epoch_iter(epoch)
+            if hasattr(self.source, "epoch_iter")
+            else iter(self.source)
+        )
+        if not self.shuffle or self.shuffle_buffer <= 1:
+            yield from it
+            return
+        rng = np.random.default_rng((self.seed, epoch))
+        buf: list = []
+        for sample in it:
+            buf.append(sample)
+            if len(buf) >= self.shuffle_buffer:
+                j = int(rng.integers(len(buf)))
+                buf[j], buf[-1] = buf[-1], buf[j]
+                yield buf.pop()
+        while buf:
+            j = int(rng.integers(len(buf)))
+            buf[j], buf[-1] = buf[-1], buf[j]
+            yield buf.pop()
+
+    def _stream_host_batches(
+        self, epoch: int, skip_batches: int = 0
+    ) -> Iterator[Any]:
+        """Host batches from a length-free stream, grouped by GLOBAL batch
+        boundary: every process scans the same stream, keeps rows
+        ``i % procs == p``, and yields exactly when a boundary of
+        ``batch_size`` global samples is crossed.  Per-process batch counts
+        therefore agree by construction — device assembly is collective, so
+        a divergent count would deadlock multi-host runs.  The trailing
+        partial batch is pad+masked (or dropped) on every process, even
+        ones holding zero (or a full slice) of its rows."""
+        procs = jax.process_count()
+        p = jax.process_index()
+        B, L = self.batch_size, self.local_batch_size
+        skip_samples = skip_batches * B
+        rows: list = []  # this process's rows of the CURRENT global batch
+        template = None
+        count = 0
+        boundary = skip_samples + B
+        for i, sample in enumerate(self._stream_shuffled(epoch)):
+            count = i + 1
+            if i < skip_samples:
+                continue
+            if i >= boundary:
+                # previous global batch saw all B samples -> full local slice
+                yield self._collate_local(rows, np.ones(L, dtype=bool))
+                rows = []
+                boundary += B
+            if i % procs == p:
+                rows.append(sample)
+                template = sample
+        remaining = max(0, count - skip_samples)
+        if remaining == 0:
+            return
+        if remaining % B == 0:
+            # stream ended exactly on a boundary: final batch is full
+            yield self._collate_local(rows, np.ones(L, dtype=bool))
+            return
+        if self.drop_last:
+            return
+        # partial final batch: pad to L with copies of a real sample,
+        # masked invalid (static shapes, SURVEY §7.4)
+        if template is None:
+            raise ValueError(
+                f"process {p}/{procs} saw no stream samples at all; a "
+                f"streaming source must yield at least one sample per "
+                f"process to form a padded batch"
+            )
+        valid = np.zeros(L, dtype=bool)
+        valid[: len(rows)] = True
+        rows = rows + [template] * (L - len(rows))
+        yield self._collate_local(rows, valid)
+
+    def _collate_local(self, samples: list, valid: np.ndarray) -> Any:
+        batch = self.collate_fn(samples)
+        if not isinstance(batch, (dict, Attributes)):
+            batch = Attributes(data=batch)
+        batch = Attributes(batch)
+        batch[self.mask_key] = valid
+        return batch
+
     # -- iteration ----------------------------------------------------------
 
     def __iter__(self) -> Iterator[Any]:
         return self.iterate(epoch=self.epoch)
 
     def iterate(self, epoch: int = 0, skip_batches: int = 0) -> Iterator[Any]:
-        """Iterate one epoch; ``skip_batches`` replays the permutation and
-        fast-forwards (mid-epoch resume, reference ``skip_first_batches``,
-        ``dataset.py:205-210``)."""
-        plan = self._batch_indices(epoch)
-        for _ in range(skip_batches):
-            next(plan, None)
+        """Iterate one epoch; ``skip_batches`` replays the permutation (or
+        stream) and fast-forwards (mid-epoch resume, reference
+        ``skip_first_batches``, ``dataset.py:205-210``)."""
+        if self.streaming:
+            host_iter = self._stream_host_batches(epoch, skip_batches)
+        else:
+            plan = self._batch_indices(epoch)
+            for _ in range(skip_batches):
+                next(plan, None)
+            host_iter = (
+                self._host_batch(idx, valid) for idx, valid in plan
+            )
         if self.prefetch <= 0:
-            for idx, valid in plan:
-                yield self._to_device(self._host_batch(idx, valid))
+            for host_batch in host_iter:
+                yield self._to_device(host_batch)
             return
-        yield from self._prefetch_iter(plan)
+        yield from self._prefetch_iter(host_iter)
 
-    def _prefetch_iter(self, plan: Iterator[tuple]) -> Iterator[Any]:
+    def _prefetch_iter(self, host_iter: Iterator[Any]) -> Iterator[Any]:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
         error: list = []
 
         def producer() -> None:
             try:
-                for idx, valid in plan:
-                    q.put(self._host_batch(idx, valid))
+                for host_batch in host_iter:
+                    q.put(host_batch)
             except BaseException as exc:  # propagate into consumer
                 error.append(exc)
             finally:

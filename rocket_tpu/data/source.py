@@ -10,7 +10,7 @@ without adapters (torch tensors are converted by the collate hooks in
 
 from __future__ import annotations
 
-from typing import Any, Callable, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 import numpy as np
 
@@ -50,6 +50,46 @@ class ArraySource(Source):
         import jax
 
         return jax.tree_util.tree_map(lambda leaf: leaf[index], self._data)
+
+
+class IterableSource:
+    """Length-free streaming sample store (reference parity: the torch
+    ``DataLoader`` accepts ``IterableDataset``, ``rocket/core/dataset.py:
+    100-126``; OpenWebText-scale LM training is a streaming workload).
+
+    Contract: every ``__iter__`` call restarts the SAME deterministic
+    stream — that is what makes multi-host sharding (every process filters
+    its rows from the common stream) and mid-epoch resume (skip-ahead
+    replays the stream) correct.  Wrap nondeterministic feeds in a cache or
+    seed them per epoch via :meth:`epoch_iter`.
+    """
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def epoch_iter(self, epoch: int):
+        """Stream for a given epoch — override to reshuffle/reseed per
+        epoch; the default ignores ``epoch`` and restarts the stream."""
+        return iter(self)
+
+
+class GeneratorSource(IterableSource):
+    """Adapt a zero-arg iterator factory (``lambda: open_stream()``) —
+    the minimal bridge for generators, HF streaming datasets, file readers.
+    An optional ``epoch_fn(epoch)`` factory reseeds per epoch."""
+
+    def __init__(self, factory: Callable[[], Any],
+                 epoch_fn: Optional[Callable[[int], Any]] = None) -> None:
+        self._factory = factory
+        self._epoch_fn = epoch_fn
+
+    def __iter__(self):
+        return iter(self._factory())
+
+    def epoch_iter(self, epoch: int):
+        if self._epoch_fn is not None:
+            return iter(self._epoch_fn(epoch))
+        return iter(self)
 
 
 class MapSource(Source):

@@ -99,20 +99,21 @@ class Looper(Dispatcher):
             epoch = int(attrs.launcher.epoch_idx or 0)
         return epoch % self._run_every == 0
 
-    def infer_repeats(self) -> int:
-        """Sum of child Dataset totals (reference ``loop.py:294-319``)."""
+    def infer_repeats(self) -> Optional[int]:
+        """Sum of child Dataset totals (reference ``loop.py:294-319``).
+        ``None`` (= run until the stream's termination vote) when a child
+        Dataset is streaming and so has no total."""
         from rocket_tpu.data.dataset import Dataset
 
-        totals = [
-            c.total
-            for c in self._capsules
-            if isinstance(c, Dataset) and c.total is not None
-        ]
-        if not totals:
+        datasets = [c for c in self._capsules if isinstance(c, Dataset)]
+        if not datasets:
             raise RuntimeError(
                 f"Looper[{self._tag}]: repeats not given and no child Dataset "
                 f"to infer them from"
             )
+        totals = [c.total for c in datasets]
+        if any(t is None for t in totals):
+            return None  # streaming: iterate until exhaustion
         return sum(totals)
 
     # -- events --------------------------------------------------------------
@@ -147,9 +148,10 @@ class Looper(Dispatcher):
             self.set(attrs)
         looper = attrs.looper
         bar = self._status_bar(looper.repeats)
-        start = self._iter_idx
         try:
-            for _ in range(start, looper.repeats):
+            # repeats=None: unbounded streaming cycle, ended by the child
+            # Dataset's termination vote when the stream exhausts.
+            while looper.repeats is None or self._iter_idx < looper.repeats:
                 attrs.batch = None
                 for capsule in self._capsules:
                     capsule.launch(attrs)
