@@ -3,6 +3,7 @@ from rocket_tpu.observe.backends import (
     MemoryBackend,
     TensorBoardBackend,
     TrackerBackend,
+    WandbBackend,
 )
 from rocket_tpu.utils.logging import RankAwareLogger, get_logger
 from rocket_tpu.observe.meter import Accuracy, Meter, Metric, StatMetric
@@ -25,5 +26,6 @@ __all__ = [
     "ImageLogger",
     "Tracker",
     "TrackerBackend",
+    "WandbBackend",
     "get_logger",
 ]

@@ -87,10 +87,48 @@ class MemoryBackend(TrackerBackend):
         self.images.append((int(step), dict(data)))
 
 
+class WandbBackend(TrackerBackend):
+    """Weights & Biases sink (reference gets wandb through accelerate's
+    ``GeneralTracker`` registry, ``rocket/core/tracker.py:86-105``).
+
+    Requires the ``wandb`` package (not a framework dependency — install
+    separately).  ``init_kwargs`` pass through to ``wandb.init`` (project,
+    name, config, ...); the run directory defaults to the experiment's
+    logging dir so artifacts stay with the version folder.
+    """
+
+    def __init__(self, logging_dir: Optional[str] = None, **init_kwargs: Any) -> None:
+        import wandb
+
+        self._wandb = wandb
+        kwargs = dict(init_kwargs)
+        if logging_dir is not None:
+            kwargs.setdefault("dir", logging_dir)
+            # logging_dir = <root>/<tag>/<version>/logs -> name "tag-vN"
+            parts = [p for p in os.path.normpath(logging_dir).split(os.sep) if p]
+            if len(parts) >= 3:
+                kwargs.setdefault("name", f"{parts[-3]}-{parts[-2]}")
+        self._run = wandb.init(**kwargs)
+
+    def log_scalars(self, data: Dict[str, Any], step: int) -> None:
+        self._run.log({k: float(v) for k, v in data.items()}, step=int(step))
+
+    def log_images(self, data: Dict[str, Any], step: int) -> None:
+        images = {
+            tag: self._wandb.Image(np.asarray(value))
+            for tag, value in data.items()
+        }
+        self._run.log(images, step=int(step))
+
+    def close(self) -> None:
+        self._run.finish()
+
+
 BACKENDS = {
     "tensorboard": TensorBoardBackend,
     "jsonl": JsonlBackend,
     "memory": MemoryBackend,
+    "wandb": WandbBackend,
 }
 
 
@@ -108,6 +146,8 @@ def resolve_backend(
         cls = BACKENDS[backend]
         if cls is MemoryBackend:
             return cls()
+        if cls is WandbBackend:
+            return cls(logging_dir)  # wandb picks its own dir when None
         if logging_dir is None:
             raise RuntimeError(
                 f"backend {backend!r} needs a project dir — give the "

@@ -58,6 +58,19 @@ class TestTracker:
         with pytest.raises(ValueError, match="unknown tracker backend"):
             resolve_backend("wandb-nope", "/tmp")
 
+    def test_wandb_backend(self, tmp_path, monkeypatch):
+        """Tracker('wandb') logs through the wandb run API (offline mode);
+        skipped when wandb is not installed (it is not a framework dep)."""
+        wandb = pytest.importorskip("wandb")
+
+        monkeypatch.setenv("WANDB_MODE", "offline")
+        backend = resolve_backend("wandb", str(tmp_path))
+        backend.log_scalars({"loss": 0.5}, step=3)
+        backend.log_images(
+            {"img": np.zeros((4, 4, 3), np.float32)}, step=3
+        )
+        backend.close()
+
 
 class TestImageLogging:
     """Image records flow producer -> tracker buffer -> backend end-to-end
