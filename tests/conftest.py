@@ -25,6 +25,12 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running tests (multi-process rendezvous)"
+    )
+
+
 @pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()
