@@ -1,21 +1,26 @@
-"""Benchmark: GPT-2 124M training-step throughput on the available chip.
+"""Benchmark: the BASELINE.json ladder's training throughput on the
+available chip — ResNet-50/CIFAR, ViT-B/16, and GPT-2 124M.
 
-Prints ONE JSON line:
-``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``
+Prints ONE JSON line PER CONFIG
+(``{"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}``),
+with the flagship GPT-2 line LAST (drivers that keep only the final line
+get the headline metric).
 
-The workload is the BASELINE.json ladder's "GPT-2 124M LM" config driven
-through the framework's own jitted train step (Module + Loss + Optimizer →
-donated step), bf16 compute, flash attention.  Steps are timed with the
-state threaded sequentially (step i+1 consumes step i's state), so async
-dispatch / caching cannot fake the measurement; the final block waits on the
-whole chain.
+Each workload runs through the framework's own jitted train step
+(Module + Loss + Optimizer capsules -> donated step), bf16 compute.  Steps
+are timed with the state threaded sequentially (step i+1 consumes step i's
+state), so async dispatch / caching cannot fake the measurement; the final
+block waits on the whole chain.
 
-``vs_baseline``: the reference (dsenushkin/rocket) publishes NO benchmark
-numbers (BASELINE.json ``"published": {}``; SURVEY §6), so the ratio is
-against the BASELINE.json north-star proxy instead: 50% model-FLOPs
-utilization of the chip's peak — vs_baseline = MFU / 0.50.
+MFU accounting: GPT-2 uses the standard analytical 6*N*tokens model-FLOPs
+formula; the vision configs read XLA's own cost analysis of the compiled
+step (conv FLOP bookkeeping by hand is error-prone).  ``vs_baseline``: the
+reference (dsenushkin/rocket) publishes NO numbers (BASELINE.json
+``"published": {}``; SURVEY §6), so the ratio is against the BASELINE.json
+north-star proxy: 50% model-FLOPs utilization — vs_baseline = MFU / 0.50.
 """
 
+import argparse
 import json
 import os
 import sys
@@ -26,6 +31,12 @@ import jax.numpy as jnp
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# Honor an explicit CPU request even though the axon plugin's sitecustomize
+# already imported jax (env alone is too late; backend choice is still lazy,
+# so flipping the config works — same pattern as tests/conftest.py).
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    jax.config.update("jax_platforms", "cpu")
 
 
 def init_devices(timeout_s: float = 120.0, attempts: int = 3):
@@ -79,8 +90,9 @@ def init_devices(timeout_s: float = 120.0, attempts: int = 3):
     }), flush=True)
     sys.exit(1)
 
+
 import rocket_tpu as rt  # noqa: E402
-from rocket_tpu.models.objectives import lm_cross_entropy  # noqa: E402
+from rocket_tpu.models.objectives import cross_entropy, lm_cross_entropy  # noqa: E402
 from rocket_tpu.models.transformer import TransformerConfig, TransformerLM  # noqa: E402
 
 
@@ -101,7 +113,7 @@ def peak_flops_per_chip() -> float:
     return 197e12
 
 
-def step_flops(cfg: TransformerConfig, batch: int, seq: int) -> float:
+def gpt2_step_flops(cfg: TransformerConfig, batch: int, seq: int) -> float:
     """Training-step model FLOPs: 6 * params * tokens + attention term."""
     n_params = (
         cfg.vocab_size * cfg.hidden  # embed (tied head reuses it)
@@ -119,64 +131,191 @@ def step_flops(cfg: TransformerConfig, batch: int, seq: int) -> float:
     return dense + attn
 
 
-def main() -> None:
-    init_devices()
-    batch, seq = 8, 1024
-    cfg = TransformerConfig.gpt2_124m(attention="auto", remat=False)
-    model = TransformerLM(cfg)
+def xla_step_flops(module, batch) -> float:
+    """Per-step FLOPs from XLA's cost analysis of the train step (vision
+    configs: hand-counting conv FLOPs is error-prone).  Reads the analysis
+    off the LOWERING where possible — a second backend compile of the
+    already-jitted step costs tens of seconds on TPU."""
+    step = module._steps["sync"]  # the donated jitted step Module built
+    lowered = step.lower(module.state, batch)
+    try:
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"])
+    except (KeyError, TypeError, NotImplementedError):
+        cost = lowered.compile().cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0]
+        return float(cost["flops"])
+
+
+def run_config(name, module, batch_np, samples_per_step, n_steps, warmup,
+               flops_fn):
+    """Time the framework train step; return the result record."""
     runtime = rt.Runtime(mixed_precision="bf16")
-    module = rt.Module(
-        model,
-        capsules=[
-            rt.Loss(lm_cross_entropy(), name="lm"),
-            rt.Optimizer(learning_rate=1e-4),
-        ],
-    )
     module.bind(runtime)
     module.setup()
-
-    rng = np.random.default_rng(0)
     batches = [
-        jax.device_put(
-            {"tokens": jnp.asarray(
-                rng.integers(0, cfg.vocab_size, size=(batch, seq)), jnp.int32
-            )},
-            runtime.batch_sharding(ndim=2),
-        )
-        for _ in range(4)
+        jax.device_put(b, runtime.batch_sharding(ndim=1)) for b in batch_np
     ]
     attrs = rt.Attributes(
         looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
     )
-
-    # warmup (compile + 2 steps)
-    for i in range(3):
-        attrs.batch = batches[i % 4]
+    for i in range(warmup):
+        attrs.batch = batches[i % len(batches)]
         module.launch(attrs)
     jax.block_until_ready(module.state.params)
 
-    n_steps = 20
     t0 = time.perf_counter()
     for i in range(n_steps):
-        attrs.batch = batches[i % 4]
+        attrs.batch = batches[i % len(batches)]
         module.launch(attrs)  # state threads: step i+1 depends on step i
     jax.block_until_ready(module.state.params)
     elapsed = time.perf_counter() - t0
 
     step_time = elapsed / n_steps
-    tokens_per_sec = batch * seq / step_time
-    mfu = step_flops(cfg, batch, seq) / step_time / peak_flops_per_chip()
-    result = {
-        "metric": "gpt2-124m train throughput (1 chip, bf16, bs8x1024)",
-        "value": round(tokens_per_sec, 1),
-        "unit": "tokens/sec/chip",
-        "vs_baseline": round(mfu / 0.50, 3),
+    try:
+        flops = flops_fn(module, batches[0])
+    except Exception as exc:  # cost analysis unavailable on this backend
+        flops = None
+        flops_err = f"{type(exc).__name__}: {exc}"
+    mfu = (flops / step_time / peak_flops_per_chip()) if flops else None
+    record = {
+        "config": name,
+        "value": round(samples_per_step / step_time, 1),
+        "vs_baseline": round(mfu / 0.50, 3) if mfu else None,
         "step_time_ms": round(step_time * 1e3, 2),
-        "mfu": round(mfu, 4),
+        "mfu": round(mfu, 4) if mfu else None,
         "device": jax.devices()[0].device_kind,
-        "baseline_note": "reference publishes no numbers (BASELINE.json published={}); vs_baseline = MFU/0.50 north-star proxy",
     }
-    print(json.dumps(result))
+    if flops is None:
+        record["flops_error"] = flops_err
+    module.destroy()
+    return record
+
+
+def bench_resnet50(n_steps, warmup):
+    from rocket_tpu.models.resnet import resnet50
+
+    B = 256
+    module = rt.Module(
+        resnet50(num_classes=10, small_images=True),
+        capsules=[
+            rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+            rt.Optimizer(learning_rate=1e-3),
+        ],
+    )
+    rng = np.random.default_rng(0)
+    batches = [
+        {"image": jnp.asarray(rng.normal(0.5, 0.25, size=(B, 32, 32, 3)),
+                              jnp.float32),
+         "label": jnp.asarray(rng.integers(0, 10, size=(B,)), jnp.int32)}
+        for _ in range(2)
+    ]
+    rec = run_config("resnet50", module, batches, B, n_steps, warmup,
+                     xla_step_flops)
+    rec.update({
+        "metric": f"resnet50-cifar train throughput (1 chip, bf16, bs{B})",
+        "unit": "samples/sec/chip",
+        "flops_source": "xla cost_analysis (fwd+bwd step)",
+    })
+    return rec
+
+
+def bench_vit_b16(n_steps, warmup):
+    from rocket_tpu.models.vit import ViT, ViTConfig
+
+    B = 64
+    module = rt.Module(
+        ViT(ViTConfig.b16()),
+        capsules=[
+            rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+            rt.Optimizer(learning_rate=1e-3),
+        ],
+    )
+    rng = np.random.default_rng(0)
+    batches = [
+        {"image": jnp.asarray(rng.normal(0.5, 0.25, size=(B, 224, 224, 3)),
+                              jnp.float32),
+         "label": jnp.asarray(rng.integers(0, 1000, size=(B,)), jnp.int32)}
+        for _ in range(2)
+    ]
+    rec = run_config("vit-b16", module, batches, B, n_steps, warmup,
+                     xla_step_flops)
+    rec.update({
+        "metric": f"vit-b16-imagenet train throughput (1 chip, bf16, bs{B})",
+        "unit": "samples/sec/chip",
+        "flops_source": "xla cost_analysis (fwd+bwd step)",
+    })
+    return rec
+
+
+def bench_gpt2(n_steps, warmup):
+    batch, seq = 8, 1024
+    cfg = TransformerConfig.gpt2_124m(attention="auto", remat=False)
+    module = rt.Module(
+        TransformerLM(cfg),
+        capsules=[
+            rt.Loss(lm_cross_entropy(), name="lm"),
+            rt.Optimizer(learning_rate=1e-4),
+        ],
+    )
+    rng = np.random.default_rng(0)
+    batches = [
+        {"tokens": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(batch, seq)), jnp.int32)}
+        for _ in range(4)
+    ]
+    rec = run_config(
+        "gpt2", module, batches, batch * seq, n_steps, warmup,
+        lambda m, b: gpt2_step_flops(cfg, batch, seq),
+    )
+    rec.update({
+        "metric": f"gpt2-124m train throughput (1 chip, bf16, bs{batch}x{seq})",
+        "unit": "tokens/sec/chip",
+        "flops_source": "analytical 6*N*tokens + attention",
+        "baseline_note": "reference publishes no numbers (BASELINE.json "
+                         "published={}); vs_baseline = MFU/0.50 north-star "
+                         "proxy",
+    })
+    return rec
+
+
+BENCHES = {
+    "resnet50": bench_resnet50,
+    "vit": bench_vit_b16,
+    "gpt2": bench_gpt2,
+}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument(
+        "--only", choices=sorted(BENCHES), default=None,
+        help="run a single config (default: full ladder, gpt2 last)",
+    )
+    parser.add_argument("--steps", type=int, default=20)
+    parser.add_argument("--warmup", type=int, default=3)
+    args = parser.parse_args()
+
+    init_devices()
+    units = {"resnet50": "samples/sec/chip", "vit": "samples/sec/chip",
+             "gpt2": "tokens/sec/chip"}
+    names = [args.only] if args.only else ["resnet50", "vit", "gpt2"]
+    for name in names:
+        try:
+            record = BENCHES[name](args.steps, args.warmup)
+        except Exception as exc:
+            record = {
+                "config": name,
+                "metric": f"{name} train throughput (1 chip, bf16)",
+                "value": None,
+                "unit": units[name],
+                "vs_baseline": None,
+                "error": f"{type(exc).__name__}: {exc}",
+            }
+        print(json.dumps(record), flush=True)
 
 
 if __name__ == "__main__":
