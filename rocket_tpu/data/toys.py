@@ -56,10 +56,53 @@ def _glyph(digit: int) -> np.ndarray:
     return img
 
 
+def _affine_batch(
+    images: np.ndarray,
+    angles: np.ndarray,
+    scales: np.ndarray,
+    dxs: np.ndarray,
+    dys: np.ndarray,
+) -> np.ndarray:
+    """Batched inverse-map bilinear rotation+scale+shift on [N, H, W]."""
+    n, h, w = images.shape
+    cy, cx = (h - 1) / 2.0, (w - 1) / 2.0
+    ys, xs = np.mgrid[0:h, 0:w].astype(np.float32)
+    ys = ys[None] - cy - dys[:, None, None]
+    xs = xs[None] - cx - dxs[:, None, None]
+    ca = np.cos(angles)[:, None, None]
+    sa = np.sin(angles)[:, None, None]
+    sc = scales[:, None, None]
+    xr = ((ca * xs + sa * ys) / sc + cx).astype(np.float32)
+    yr = ((-sa * xs + ca * ys) / sc + cy).astype(np.float32)
+    x0 = np.floor(xr).astype(np.int32)
+    y0 = np.floor(yr).astype(np.int32)
+    fx, fy = xr - x0, yr - y0
+    out = np.zeros_like(images, dtype=np.float32)
+    idx = np.arange(n, dtype=np.int32)[:, None, None]
+    for oy in (0, 1):
+        for ox in (0, 1):
+            yi, xi = y0 + oy, x0 + ox
+            wgt = (fy if oy else 1 - fy) * (fx if ox else 1 - fx)
+            valid = (yi >= 0) & (yi < h) & (xi >= 0) & (xi < w)
+            vals = images[
+                idx, np.clip(yi, 0, h - 1), np.clip(xi, 0, w - 1)
+            ]
+            out += np.where(valid, vals * wgt, np.float32(0.0))
+    return out
+
+
 def synthetic_mnist(
-    n_train: int = 8192, n_test: int = 2048, seed: int = 0
+    n_train: int = 8192, n_test: int = 2048, seed: int = 0, hard: bool = False
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
     """MNIST-shaped synthetic digits: glyphs + shift jitter + pixel noise.
+
+    ``hard=True`` layers on label-preserving nuisance factors sized to make
+    the task comparable to real MNIST for a small CNN (the committed
+    ≥99%-accuracy north-star evidence trains on this set, BASELINE.json
+    configs[0]): per-sample rotation (±18°), scale (0.75–1.15), stroke
+    dilation/erosion, and noise of varying strength.  (No occlusion: on
+    7-segment glyphs a bar over a distinguishing segment makes two digits
+    genuinely identical, putting the Bayes error above the 1% target.)
 
     Returns ``(train, test)`` dicts with ``image`` ``[N, 28, 28, 1]`` float32
     in [0, 1] and ``label`` int32.
@@ -69,11 +112,39 @@ def synthetic_mnist(
     def make(n: int, rng: np.random.Generator) -> Dict[str, np.ndarray]:
         labels = rng.integers(0, 10, size=n)
         images = glyphs[labels].copy()
-        # random shifts +-3 px
-        for i in range(n):
-            dx, dy = rng.integers(-3, 4, size=2)
-            images[i] = np.roll(np.roll(images[i], dy, axis=0), dx, axis=1)
-        images += rng.normal(0, 0.25, size=images.shape).astype(np.float32)
+        if hard:
+            # stroke-width variation: dilate or erode with a 3x3 max/min
+            pad = np.pad(images, ((0, 0), (1, 1), (1, 1)))
+            shifted = [
+                pad[:, 1 + dy : 29 + dy, 1 + dx : 29 + dx]
+                for dy in (-1, 0, 1) for dx in (-1, 0, 1)
+            ]
+            dilated = np.maximum.reduce(shifted)
+            eroded = np.minimum.reduce(shifted)
+            stroke = rng.integers(0, 3, size=n)  # 0 keep, 1 dilate, 2 erode
+            images = np.where(
+                (stroke == 1)[:, None, None], dilated,
+                np.where((stroke == 2)[:, None, None], eroded, images),
+            )
+            images = _affine_batch(
+                images,
+                angles=rng.uniform(-0.32, 0.32, size=n).astype(np.float32),
+                scales=rng.uniform(0.75, 1.15, size=n).astype(np.float32),
+                dxs=rng.integers(-3, 4, size=n).astype(np.float32),
+                dys=rng.integers(-3, 4, size=n).astype(np.float32),
+            )
+            sigma = rng.uniform(0.15, 0.35, size=(n, 1, 1)).astype(np.float32)
+            images += (rng.standard_normal(images.shape) * sigma).astype(
+                np.float32
+            )
+        else:
+            # random shifts +-3 px
+            for i in range(n):
+                dx, dy = rng.integers(-3, 4, size=2)
+                images[i] = np.roll(
+                    np.roll(images[i], dy, axis=0), dx, axis=1
+                )
+            images += rng.normal(0, 0.25, size=images.shape).astype(np.float32)
         images = np.clip(images, 0.0, 1.0)
         return {
             "image": images[..., None].astype(np.float32),

@@ -124,6 +124,27 @@ class WandbBackend(TrackerBackend):
         self._run.finish()
 
 
+class CompositeBackend(TrackerBackend):
+    """Fan-out to several sinks (``Tracker(["tensorboard", "jsonl"])``) —
+    the reference logs to every `log_with` backend at once
+    (``rocket/core/tracker.py:86-105``)."""
+
+    def __init__(self, backends: list) -> None:
+        self.backends = backends
+
+    def log_scalars(self, data: Dict[str, Any], step: int) -> None:
+        for b in self.backends:
+            b.log_scalars(data, step)
+
+    def log_images(self, data: Dict[str, Any], step: int) -> None:
+        for b in self.backends:
+            b.log_images(data, step)
+
+    def close(self) -> None:
+        for b in self.backends:
+            b.close()
+
+
 BACKENDS = {
     "tensorboard": TensorBoardBackend,
     "jsonl": JsonlBackend,
@@ -137,6 +158,10 @@ def resolve_backend(
 ) -> TrackerBackend:
     if isinstance(backend, TrackerBackend):
         return backend
+    if isinstance(backend, (list, tuple)):
+        return CompositeBackend(
+            [resolve_backend(b, logging_dir) for b in backend]
+        )
     if isinstance(backend, str):
         if backend not in BACKENDS:
             raise ValueError(

@@ -45,11 +45,15 @@ class Tracker(Capsule):
 
     def setup(self, attrs: Optional[Attributes] = None) -> None:
         super().setup(attrs)
-        name = (
-            self._backend_spec
-            if isinstance(self._backend_spec, str)
-            else type(self._backend_spec).__name__
-        )
+        spec = self._backend_spec
+        if isinstance(spec, (list, tuple)):  # composite fan-out
+            name = "+".join(
+                s if isinstance(s, str) else type(s).__name__ for s in spec
+            )
+        elif isinstance(spec, str):
+            name = spec
+        else:
+            name = type(spec).__name__
         existing = self._runtime.get_tracker(name)
         if existing is not None:
             self._backend = existing  # shared across pipeline branches

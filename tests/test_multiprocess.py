@@ -7,6 +7,7 @@ assert_equal — plus per-host batch sharding and a coordinated multi-host
 Orbax save/restore through the Launcher.  No monkeypatching anywhere.
 """
 
+import contextlib
 import os
 import socket
 import subprocess
@@ -40,29 +41,30 @@ def test_real_multiprocess_pipeline(tmp_path):
     # buffer would stall before the rendezvous barrier and turn the real
     # error into an opaque timeout.
     logs = [tmp_path / f"worker{pid}.log" for pid in range(N_PROCS)]
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(port), str(N_PROCS), str(pid),
-             str(tmp_path)],
-            stdout=open(logs[pid], "w"),
-            stderr=subprocess.STDOUT,
-            text=True,
-            env=env,
-        )
-        for pid in range(N_PROCS)
-    ]
-    try:
-        for p in procs:
-            p.wait(timeout=TIMEOUT_S)
-    except subprocess.TimeoutExpired:
-        for p in procs:
-            p.kill()
-        for p in procs:
-            p.wait()
-        outputs = [log.read_text() for log in logs]
-        pytest.fail(
-            "multi-process workers timed out\n" + "\n---\n".join(outputs)
-        )
+    procs = []
+    with contextlib.ExitStack() as stack:
+        for pid in range(N_PROCS):
+            log_file = stack.enter_context(open(logs[pid], "w"))
+            procs.append(subprocess.Popen(
+                [sys.executable, worker, str(port), str(N_PROCS), str(pid),
+                 str(tmp_path)],
+                stdout=log_file,
+                stderr=subprocess.STDOUT,
+                text=True,
+                env=env,
+            ))
+        try:
+            for p in procs:
+                p.wait(timeout=TIMEOUT_S)
+        except subprocess.TimeoutExpired:
+            for p in procs:
+                p.kill()
+            for p in procs:
+                p.wait()
+            outputs = [log.read_text() for log in logs]
+            pytest.fail(
+                "multi-process workers timed out\n" + "\n---\n".join(outputs)
+            )
     for pid, p in enumerate(procs):
         out = logs[pid].read_text()
         assert p.returncode == 0, (
