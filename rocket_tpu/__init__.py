@@ -26,7 +26,7 @@ from rocket_tpu.data import (
     GeneratorSource,
     IterableSource,
 )
-from rocket_tpu.launch import Launcher, Looper
+from rocket_tpu.launch import Launcher, Looper, notebook_launch
 from rocket_tpu.observe import Accuracy, ImageLogger, Meter, Metric, StatMetric, Tracker
 from rocket_tpu.persist import Checkpointer
 from rocket_tpu.runtime import Runtime
@@ -47,6 +47,7 @@ __all__ = [
     "Launcher",
     "Looper",
     "Loss",
+    "notebook_launch",
     "Accuracy",
     "ImageLogger",
     "Meter",

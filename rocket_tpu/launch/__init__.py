@@ -1,4 +1,5 @@
 from rocket_tpu.launch.launcher import Launcher
 from rocket_tpu.launch.loop import Looper
+from rocket_tpu.launch.notebook import in_notebook, notebook_launch
 
-__all__ = ["Launcher", "Looper"]
+__all__ = ["Launcher", "Looper", "in_notebook", "notebook_launch"]

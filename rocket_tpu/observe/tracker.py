@@ -46,22 +46,29 @@ class Tracker(Capsule):
     def setup(self, attrs: Optional[Attributes] = None) -> None:
         super().setup(attrs)
         spec = self._backend_spec
-        if isinstance(spec, (list, tuple)):  # composite fan-out
-            name = "+".join(
-                s if isinstance(s, str) else type(s).__name__ for s in spec
+        if isinstance(spec, (list, tuple)):
+            # Composite fan-out: dedupe PER COMPONENT through the runtime
+            # registry — Tracker("jsonl") in one branch and
+            # Tracker(["tensorboard", "jsonl"]) in another must share ONE
+            # jsonl writer, not append to the same file twice.
+            from rocket_tpu.observe.backends import CompositeBackend
+
+            self._backend = CompositeBackend(
+                [self._resolve_shared(s) for s in spec]
             )
-        elif isinstance(spec, str):
-            name = spec
-        else:
-            name = type(spec).__name__
+            return
+        self._backend = self._resolve_shared(spec)
+
+    def _resolve_shared(self, spec: Any) -> TrackerBackend:
+        """Resolve one backend spec through the runtime registry (shared
+        across pipeline branches; closed once by runtime.end_training)."""
+        name = spec if isinstance(spec, str) else type(spec).__name__
         existing = self._runtime.get_tracker(name)
         if existing is not None:
-            self._backend = existing  # shared across pipeline branches
-            return
-        self._backend = resolve_backend(
-            self._backend_spec, self._runtime.logging_dir
-        )
-        self._runtime.register_tracker(name, self._backend)
+            return existing
+        backend = resolve_backend(spec, self._runtime.logging_dir)
+        self._runtime.register_tracker(name, backend)
+        return backend
 
     def destroy(self, attrs: Optional[Attributes] = None) -> None:
         self._backend = None  # closed by runtime.end_training()

@@ -1,0 +1,46 @@
+"""Fresh parent for the notebook_launch fork-N test (not a pytest file).
+
+Run as: python notebook_parent.py <workdir>.  Must NOT initialize any JAX
+backend before notebook_launch — that is the constraint under test.
+"""
+
+import os
+import sys
+
+
+def worker(workdir: str) -> None:
+    import jax
+    import numpy as np
+
+    from rocket_tpu.parallel import multihost
+
+    pid = jax.process_index()
+    assert jax.process_count() == 2
+    # real host collectives inside the forked workers
+    got = multihost.broadcast_object(
+        {"token": 99} if pid == 0 else None
+    )
+    assert got == {"token": 99}, got
+    gathered = multihost.process_allgather(np.asarray([pid], np.int32))
+    np.testing.assert_array_equal(np.sort(np.ravel(gathered)), [0, 1])
+    with open(os.path.join(workdir, f"nb{pid}.ok"), "w") as f:
+        f.write("ok")
+
+
+def main() -> None:
+    workdir = sys.argv[1]
+    from rocket_tpu import notebook_launch
+
+    # 1-process mode: runs inline, returns the value
+    assert notebook_launch(lambda: 41 + 1) == 42
+
+    # fork-N mode (closure over workdir — the reason forking, not
+    # pickling, is the mechanism)
+    notebook_launch(worker, args=(workdir,), num_processes=2)
+    assert os.path.exists(os.path.join(workdir, "nb0.ok"))
+    assert os.path.exists(os.path.join(workdir, "nb1.ok"))
+    print("NOTEBOOK-PARENT-OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""notebook_launch (reference @notebook / notebook_launcher parity,
+``rocket/core/launcher.py:202-253``): inline 1-process mode, fork-N local
+workers with a real jax.distributed rendezvous, and the backend-already-
+initialized guard."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from rocket_tpu.launch.notebook import in_notebook, notebook_launch
+
+
+def test_single_process_runs_inline():
+    calls = []
+
+    def fn(x):
+        calls.append(x)
+        return x * 2
+
+    assert notebook_launch(fn, args=(21,)) == 42
+    assert calls == [21]
+
+
+def test_fork_refused_once_backends_exist(devices):
+    """This pytest process has live CPU backends (the devices fixture), so
+    fork-N must refuse with the accelerate-style guidance."""
+    with pytest.raises(RuntimeError, match="already initialized"):
+        notebook_launch(lambda: None, num_processes=2)
+
+
+def test_not_in_notebook():
+    assert in_notebook() is False
+
+
+@pytest.mark.slow
+def test_fork_n_workers_rendezvous(tmp_path):
+    """Fresh parent (no JAX backends) forks 2 workers that rendezvous via
+    jax.distributed and run real host collectives over a notebook-style
+    closure."""
+    parent = os.path.join(os.path.dirname(__file__), "notebook_parent.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(parent))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    env.pop("JAX_PLATFORMS", None)
+    env.pop("XLA_FLAGS", None)
+    out = subprocess.run(
+        [sys.executable, parent, str(tmp_path)],
+        capture_output=True, text=True, timeout=300, env=env,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "NOTEBOOK-PARENT-OK" in out.stdout, out.stdout + out.stderr

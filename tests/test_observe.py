@@ -45,6 +45,23 @@ class TestTracker:
             t.setup()
         assert t1._backend is t2._backend
 
+    def test_composite_shares_components_with_plain_tracker(self):
+        """Tracker('memory') and Tracker(['memory', ...]) must share ONE
+        component instance — duplicate writers on the same sink would
+        interleave/duplicate records."""
+        runtime = rt.Runtime()
+        plain = rt.Tracker("memory")
+        composite = rt.Tracker(["memory"])
+        for t in (plain, composite):
+            t.bind(runtime)
+            t.setup()
+        assert composite._backend.backends[0] is plain._backend
+        # two composites (any order) also share
+        composite2 = rt.Tracker(["memory"])
+        composite2.bind(runtime)
+        composite2.setup()
+        assert composite2._backend.backends[0] is plain._backend
+
     def test_jsonl_backend(self, tmp_path):
         backend = JsonlBackend(str(tmp_path))
         backend.log_scalars({"a": 1.5}, step=7)
