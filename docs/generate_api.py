@@ -35,8 +35,12 @@ MODULES = {
     "rocket_tpu.runtime": "Runtime (mesh, policy, registries)",
     "rocket_tpu.launch.launcher": "Launcher (epoch loop, resume)",
     "rocket_tpu.launch.loop": "Looper (iteration loop)",
+    "rocket_tpu.launch.notebook": "Notebook / interactive launch",
     "rocket_tpu.data.dataset": "Dataset capsule",
-    "rocket_tpu.data.loader": "Data loader (per-host sharded)",
+    "rocket_tpu.data.loader": "Data loader (per-host sharded, streaming)",
+    "rocket_tpu.data.source": "Data sources (map-style + streaming)",
+    "rocket_tpu.parallel.pipeline": "GPipe pipeline parallelism",
+    "rocket_tpu.models.moe": "Mixture-of-Experts (expert parallel)",
     "rocket_tpu.engine.state": "TrainState pytree",
     "rocket_tpu.engine.step": "Jitted step builders",
     "rocket_tpu.engine.precision": "Mixed-precision policy",
