@@ -111,7 +111,7 @@ class TransformerConfig:
 
     @classmethod
     def gpt2_124m(cls, **kw) -> "TransformerConfig":
-        return cls(
+        defaults = dict(
             vocab_size=50257,
             hidden=768,
             n_layers=12,
@@ -122,8 +122,9 @@ class TransformerConfig:
             positions="learned",
             tie_embeddings=True,
             use_bias=True,
-            **kw,
         )
+        defaults.update(kw)
+        return cls(**defaults)
 
     @classmethod
     def llama2_7b(cls, **kw) -> "TransformerConfig":
