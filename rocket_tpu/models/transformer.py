@@ -177,7 +177,8 @@ class Attention(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids, train: bool):
+    def __call__(self, x, positions, segment_ids, train: bool,
+                 decode: bool = False):
         cfg = self.config
         B, S, _ = x.shape
         H, KV, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
@@ -199,16 +200,19 @@ class Attention(nn.Module):
             cos, sin = rotary_embedding(positions, D, cfg.rope_theta, x.dtype)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
-        out = attend(
-            q,
-            k,
-            v,
-            impl=cfg.attention,
-            causal=cfg.causal,
-            segment_ids=segment_ids,
-            block_q=cfg.attention_block_q,
-            block_k=cfg.attention_block_k,
-        )
+        if decode:
+            out = self._decode_attend(q, k, v)
+        else:
+            out = attend(
+                q,
+                k,
+                v,
+                impl=cfg.attention,
+                causal=cfg.causal,
+                segment_ids=segment_ids,
+                block_q=cfg.attention_block_q,
+                block_k=cfg.attention_block_k,
+            )
         out = out.reshape(B, S, H * D)
         out = PDense(
             cfg.hidden,
@@ -221,6 +225,35 @@ class Attention(nn.Module):
         if cfg.dropout and train:
             out = nn.Dropout(cfg.dropout, deterministic=False)(out)
         return out
+
+    def _decode_attend(self, q, k, v):
+        """KV-cache attention for autoregressive decode (the standard flax
+        ``cache`` collection pattern): new K/V are written at the cache
+        frontier, q attends against everything written so far."""
+        from rocket_tpu.ops.attention import dot_attention
+
+        cfg = self.config
+        B, S, KV, D = k.shape
+        is_filled = self.has_variable("cache", "cached_k")
+        cached_k = self.variable(
+            "cache", "cached_k", jnp.zeros, (B, cfg.max_seq, KV, D), k.dtype
+        )
+        cached_v = self.variable(
+            "cache", "cached_v", jnp.zeros, (B, cfg.max_seq, KV, D), v.dtype
+        )
+        cache_index = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        if not is_filled:
+            # init pass: create the cache shapes, attend normally
+            return attend(q, k, v, impl="dot", causal=cfg.causal)
+        idx = cache_index.value
+        k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
+        v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+        cached_k.value = k_all
+        cached_v.value = v_all
+        cache_index.value = idx + S
+        return dot_attention(q, k_all, v_all, causal=True, q_offset=idx)
 
 
 class MLP(nn.Module):
@@ -263,11 +296,13 @@ class Block(nn.Module):
     config: TransformerConfig
 
     @nn.compact
-    def __call__(self, x, positions, segment_ids, train: bool):
+    def __call__(self, x, positions, segment_ids, train: bool,
+                 decode: bool = False):
         cfg = self.config
         x = constrain(x, "batch", "sequence", "act_embed")
         x = x + Attention(cfg, name="attn")(
-            _Norm(cfg, name="ln1")(x), positions, segment_ids, train
+            _Norm(cfg, name="ln1")(x), positions, segment_ids, train,
+            decode=decode,
         )
         aux = jnp.zeros((), jnp.float32)
         h = _Norm(cfg, name="ln2")(x)
@@ -371,8 +406,15 @@ class TransformerLM(nn.Module):
     logits_key: str = "logits"
 
     @nn.compact
-    def __call__(self, batch, train: bool = False):
+    def __call__(self, batch, train: bool = False, decode: bool = False):
         cfg = self.config
+        if decode and (cfg.scan_layers or cfg.remat
+                       or cfg.pipeline_microbatches > 0):
+            raise ValueError(
+                "decode=True (KV-cache generation) requires the plain "
+                "unrolled layer layout: scan_layers=False, remat=False, "
+                "pipeline_microbatches=0"
+            )
         tokens = batch[self.tokens_key]
         B, S = tokens.shape
         given_positions = batch.get("positions") if hasattr(batch, "get") else None
@@ -423,9 +465,14 @@ class TransformerLM(nn.Module):
             moe_aux = jnp.sum(aux_per_layer)
         else:
             moe_aux = jnp.zeros((), jnp.float32)
+            # nn.remat traces kwargs (static_argnums covers positional
+            # 'train' only), so the decode flag — always False with remat,
+            # the guard above rejects the combination — must not be passed
+            # through a remat-wrapped block.
+            extra = {} if cfg.remat else {"decode": decode}
             for i in range(cfg.n_layers):
                 x, aux = block_cls(cfg, name=f"block_{i}")(
-                    x, positions, segment_ids, train
+                    x, positions, segment_ids, train, **extra
                 )
                 moe_aux = moe_aux + aux
 

@@ -48,10 +48,16 @@ def dot_attention(
     causal: bool = True,
     segment_ids: Optional[Array] = None,
     scale: Optional[float] = None,
+    q_offset: Optional[Array] = None,
 ) -> Array:
     """Reference einsum attention. Computes logits in f32 for stability
     regardless of the compute dtype (bf16 inputs stay bf16 on the matmuls —
     MXU native — with an f32 softmax accumulator, XLA's preferred pattern).
+
+    ``q_offset`` positions the queries at ``q_offset .. q_offset+S-1``
+    within the key axis — the KV-cache decode case, where K/V span the
+    whole cache (``[B, T, KV, D]``, zeros past the write frontier masked
+    out causally) while q holds only the newest token(s).
     """
     B, S, H, D = q.shape
     k, v = _repeat_kv(k, v, H)
@@ -60,6 +66,8 @@ def dot_attention(
     logits = logits * scale
     if causal:
         q_pos = jnp.arange(S)[:, None]
+        if q_offset is not None:
+            q_pos = q_pos + q_offset
         k_pos = jnp.arange(k.shape[1])[None, :]
         mask = q_pos >= k_pos
         logits = jnp.where(mask[None, None], logits, -jnp.inf)

@@ -8,6 +8,7 @@ import optax
 import pytest
 
 import rocket_tpu as rt
+import flax.linen as nn
 from rocket_tpu.models.lora import freeze_non_lora, lora_labels
 from rocket_tpu.models.objectives import cross_entropy, lm_cross_entropy
 from rocket_tpu.models.resnet import ResNet
@@ -354,6 +355,76 @@ def test_moe_sort_dispatch_memory_scales(devices):
     # ~10MB per tensor); sort path carries only [B,K*S] routing vectors
     # and the [E,C,D] buffers both paths share.
     assert sort_bytes < onehot_bytes / 2, (sort_bytes, onehot_bytes)
+
+
+@pytest.mark.parametrize("style", ["gpt2", "llama"])
+def test_generate_cached_matches_full_forward(devices, style):
+    """KV-cache greedy decode must emit EXACTLY the tokens that repeated
+    full forwards would: the cache is an optimization, not a semantics
+    change.  Covers learned positions (gpt2 style) and RoPE + GQA (llama
+    style)."""
+    import jax.numpy as jnp
+
+    from rocket_tpu.models.generate import generate
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    if style == "gpt2":
+        cfg = TransformerConfig(
+            vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=48,
+            norm="layernorm", mlp="gelu", positions="learned",
+            tie_embeddings=True, use_bias=True, attention="dot",
+        )
+    else:
+        cfg = TransformerConfig(
+            vocab_size=64, hidden=32, n_layers=2, n_heads=4, n_kv_heads=2,
+            max_seq=48, attention="dot",
+        )
+    model = TransformerLM(cfg)
+    B, P, NEW = 2, 8, 6
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(B, P)), jnp.int32
+    )
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+
+    got = generate(model, params, prompt, max_new_tokens=NEW, temperature=0.0)
+    assert got.shape == (B, P + NEW)
+
+    # oracle: grow the sequence with full (uncached) forwards
+    seq = prompt
+    for _ in range(NEW):
+        out = model.apply({"params": params}, {"tokens": seq})
+        nxt = jnp.argmax(out["logits"][:, -1], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(seq))
+
+
+def test_generate_sampling_shapes_and_jit(devices):
+    """Temperature/top-k sampling path runs under jit and respects the
+    vocab bound."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from rocket_tpu.models.generate import generate
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=32, hidden=16, n_layers=1, n_heads=2, max_seq=32,
+        attention="dot",
+    )
+    model = TransformerLM(cfg)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), {"tokens": prompt})["params"]
+    )
+    gen = jax.jit(functools.partial(
+        generate, model, max_new_tokens=5, temperature=0.7, top_k=8
+    ))
+    got = gen(params, prompt, rng=jax.random.PRNGKey(3))
+    assert got.shape == (2, 9)
+    assert int(jnp.max(got)) < 32 and int(jnp.min(got)) >= 0
 
 
 def test_lora_freezes_base_weights(devices):
