@@ -193,6 +193,14 @@ def run_config(name, module, batch_np, samples_per_step, n_steps, warmup,
     }
     if flops is None:
         record["flops_error"] = flops_err
+    if mfu is not None and mfu > 1.0:
+        # >100% MFU is physically impossible — the executable was
+        # miscompiled into (near) a no-op, not a fast run.  Seen with
+        # scan_layers=True on the experimental axon TPU backend: a
+        # fresh-process compile of the same config never finishes, while
+        # in a warm process it "runs" at >50x peak.
+        record["suspect"] = "mfu > 1.0 — miscompiled executable"
+        record["vs_baseline"] = None
     module.destroy()
     return record
 
@@ -322,7 +330,12 @@ def sweep_gpt2(n_steps, warmup):
             rec = {"tune": dict(GPT2_TUNE, **point), "value": None,
                    "error": f"{type(exc).__name__}: {exc}"}
         print(json.dumps({"sweep_point": point, **rec}), flush=True)
-        if rec.get("value") and (best is None or rec["value"] > best["value"]):
+        # Selection needs a trustworthy measurement: a real value, a real
+        # MFU (the gpt2 analytical formula always provides one), and no
+        # suspect flag (run_config marks physically impossible >100%-MFU
+        # points — miscompiled executables, not fast runs).
+        if (rec.get("value") and rec.get("mfu") and "suspect" not in rec
+                and (best is None or rec["value"] > best["value"])):
             best = rec
     if best is not None:
         print(json.dumps({"sweep_best": best["tune"],

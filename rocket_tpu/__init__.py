@@ -27,7 +27,16 @@ from rocket_tpu.data import (
     IterableSource,
 )
 from rocket_tpu.launch import Launcher, Looper, notebook_launch
-from rocket_tpu.observe import Accuracy, ImageLogger, Meter, Metric, StatMetric, Tracker
+from rocket_tpu.observe import (
+    Accuracy,
+    ImageLogger,
+    Meter,
+    Metric,
+    Profiler,
+    StatMetric,
+    Throughput,
+    Tracker,
+)
 from rocket_tpu.persist import Checkpointer
 from rocket_tpu.runtime import Runtime
 
@@ -52,7 +61,9 @@ __all__ = [
     "ImageLogger",
     "Meter",
     "Metric",
+    "Profiler",
     "StatMetric",
+    "Throughput",
     "Module",
     "Optimizer",
     "Runtime",

@@ -96,13 +96,16 @@ def _fwd_kernel(*refs, scale: float, causal: bool, has_seg: bool,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [bq, D]
-        k = k_ref[0, 0].astype(jnp.float32)  # [bk, D]
-        v = v_ref[0, 0].astype(jnp.float32)  # [bk, D]
+        # Matmul operands stay in the input dtype (bf16 in mixed-precision
+        # runs) — the MXU's native bf16xbf16->f32 path runs ~4x the f32
+        # rate on v5e; only the softmax math is f32.
+        q = q_ref[0, 0]  # [bq, D]
+        k = k_ref[0, 0]  # [bk, D]
+        v = v_ref[0, 0]  # [bk, D]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [bq, bk]
+        ) * scale  # [bq, bk] f32
         mask = _block_mask(causal, has_seg, qi, ki, sq_ref, sk_ref,
                            block_q, block_k)
         if mask is not None:
@@ -116,7 +119,7 @@ def _fwd_kernel(*refs, scale: float, causal: bool, has_seg: bool,
         correction = jnp.exp(m_prev - m_new)  # [bq, 1]
         l_new = correction * l_prev + jnp.sum(p, axis=-1, keepdims=True)
         acc_ref[:] = acc_ref[:] * correction + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
@@ -230,10 +233,11 @@ def _dq_kernel(*refs, scale: float, causal: bool, has_seg: bool,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 matmul operands, f32 softmax math (see _fwd_kernel note).
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]  # [bq, 1] (lane-broadcast layout)
         delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(
@@ -248,10 +252,10 @@ def _dq_kernel(*refs, scale: float, causal: bool, has_seg: bool,
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        )  # [bq, bk]
+        )  # [bq, bk] f32
         ds = p * (dp - delta)
         acc_ref[:] += scale * jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ())),
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -284,16 +288,17 @@ def _dkv_kernel(*refs, scale: float, causal: bool, has_seg: bool,
 
     @pl.when(run)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        # bf16 matmul operands, f32 softmax math (see _fwd_kernel note).
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0][:, :1]
         delta = delta_ref[0, 0][:, :1]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ) * scale  # [bq, bk]
+        ) * scale  # [bq, bk] f32
         p = jnp.exp(s - lse)
         mask = _block_mask(causal, has_seg, qi, ki, sq_ref, sk_ref,
                            block_q, block_k)
@@ -301,7 +306,7 @@ def _dkv_kernel(*refs, scale: float, causal: bool, has_seg: bool,
             p = jnp.where(mask, p, 0.0)
         # dV += Pᵀ dO
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
@@ -311,7 +316,7 @@ def _dkv_kernel(*refs, scale: float, causal: bool, has_seg: bool,
         ds = p * (dp - delta)
         # dK += dSᵀ Q * scale
         dk_acc[:] += scale * jax.lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
 
@@ -416,7 +421,8 @@ def _flash_fwd_rule(q, k, v, seg, causal, scale, block_q, block_k):
 def _flash_bwd_rule(causal, scale, block_q, block_k, res, g):
     q, k, v, seg, o, lse = res
     dq, dk, dv = _flash_bwd(
-        q, k, v, seg, o, lse, g, causal, scale, block_q, block_k
+        q, k, v, seg, o, lse, g.astype(q.dtype), causal, scale,
+        block_q, block_k
     )
     dseg = None if seg is None else jnp.zeros_like(seg)
     return dq, dk, dv, dseg
@@ -460,6 +466,11 @@ def flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
         )
     k, v = _repeat_kv(k, v, H)
+    # The kernels run their matmuls in the input dtype (no internal f32
+    # casts), and dot_general needs matching operand dtypes — normalize
+    # mixed-precision callers to q's dtype here.
+    k = k.astype(q.dtype)
+    v = v.astype(q.dtype)
     seg = None if segment_ids is None else segment_ids.astype(jnp.float32)
     # [B, S, H, D] -> [B, H, S, D] for the kernel
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))

@@ -66,6 +66,58 @@ def test_flash_gqa():
     )
 
 
+def test_flash_bf16_matches_dot():
+    """The kernels run their matmuls on the raw input dtype (bf16 on MXU
+    rather than f32 upcasts); bf16 values and grads must still track the
+    dot oracle within bf16 resolution."""
+    q, k, v = _qkv(S=128, dtype=jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+        return jnp.sum(out.astype(jnp.float32))
+
+    def loss_dot(q, k, v):
+        return jnp.sum(dot_attention(q, k, v, causal=True).astype(jnp.float32))
+
+    out_flash = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    out_dot = dot_attention(q, k, v, causal=True)
+    assert out_flash.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(out_flash, np.float32), np.asarray(out_dot, np.float32),
+        atol=2e-2, rtol=2e-2,
+    )
+    g_flash = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    g_dot = jax.grad(loss_dot, argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_flash, g_dot, "qkv"):
+        assert bool(jnp.isfinite(gf.astype(jnp.float32)).all()), f"d{name} nan"
+        # bf16 grads: both sides round to bf16 but in different orders, so
+        # the tolerance is bf16-epsilon scaled by the grad magnitude (~S).
+        np.testing.assert_allclose(
+            np.asarray(gf, np.float32), np.asarray(gd, np.float32),
+            atol=1.0, rtol=0.1, err_msg=f"d{name} mismatch",
+        )
+
+
+def test_flash_mixed_dtype_inputs():
+    """bf16 q with f32 k/v (values kept in higher precision) must trace and
+    run — the wrapper normalizes k/v to q's dtype for the kernels."""
+    q, _, _ = _qkv(S=128, dtype=jnp.bfloat16)
+    _, k, v = _qkv(S=128, dtype=jnp.float32)
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    assert out.dtype == jnp.bfloat16
+    grads = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64
+            ).astype(jnp.float32)
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    assert tuple(g.dtype for g in grads) == (jnp.bfloat16, jnp.float32, jnp.float32)
+    for g in grads:
+        assert bool(jnp.isfinite(g.astype(jnp.float32)).all())
+
+
 def _packed_segments(B, S, seed=3):
     """Two documents per row, boundary varying per row."""
     rng = np.random.default_rng(seed)
