@@ -267,7 +267,8 @@ def bench_vit_b16(n_steps, warmup):
 # extra logits are never targeted by data (ids < 50257) and their FLOPs
 # ARE executed, so the analytical formula counts the padded size.
 GPT2_TUNE = dict(batch=8, seq=1024, block_q=256, block_k=512,
-                 vocab=50304, scan_layers=False, remat=False)
+                 vocab=50304, scan_layers=False, remat=False,
+                 fused_qkv=False, fused_ce=False)
 
 
 def bench_gpt2(n_steps, warmup, tune=None):
@@ -280,6 +281,8 @@ def bench_gpt2(n_steps, warmup, tune=None):
         attention_block_k=t["block_k"],
         scan_layers=t["scan_layers"],
         remat=t["remat"],
+        fused_qkv=t["fused_qkv"],
+        fused_ce=t["fused_ce"],
     )
     module = rt.Module(
         TransformerLM(cfg),
@@ -320,6 +323,12 @@ def sweep_gpt2(n_steps, warmup):
                    (512, 512), (512, 1024)):
         grid.append({"block_q": bq, "block_k": bk})
     grid.append({"vocab": 50257})       # unpadded-vocab ablation
+    grid.append({"fused_qkv": True})    # one wide qkv matmul ablation
+    grid.append({"fused_ce": True})     # logits-free LM loss ablation
+    # fused_ce frees the [B*S, vocab] logits memory — the big-batch points
+    # only fit with it on.
+    grid.append({"fused_ce": True, "batch": 32})
+    grid.append({"fused_ce": True, "batch": 64})
     grid.append({"scan_layers": True})  # scan ablation
     grid.append({"remat": True})        # remat ablation
     best = None

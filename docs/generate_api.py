@@ -15,6 +15,7 @@ from __future__ import annotations
 import importlib
 import inspect
 import os
+import re
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -51,6 +52,7 @@ MODULES = {
     "rocket_tpu.parallel.multihost": "Host-level coordination (DCN)",
     "rocket_tpu.ops.attention": "Attention dispatch",
     "rocket_tpu.ops.flash": "Pallas flash attention (TPU kernel)",
+    "rocket_tpu.ops.fused_ce": "Fused logits-free linear cross-entropy",
     "rocket_tpu.ops.ring": "Ring attention (sequence parallel)",
     "rocket_tpu.observe.meter": "Meter / Metric (distributed eval metrics)",
     "rocket_tpu.observe.tracker": "Tracker + ImageLogger",
@@ -72,13 +74,19 @@ MODULES = {
 
 def _signature(obj) -> str:
     try:
-        return str(inspect.signature(obj))
+        sig = str(inspect.signature(obj))
     except (ValueError, TypeError):
         return "(...)"
+    # Default-value reprs of functions/objects embed memory addresses
+    # ("<function adamw at 0x7f..>"), which would churn every page on every
+    # regeneration — strip them so output is deterministic.
+    return re.sub(r" at 0x[0-9a-f]+", "", sig)
 
 
 def _doc(obj) -> str:
-    return inspect.getdoc(obj) or ""
+    # flax dataclass docstrings embed the constructor signature, sentinel
+    # reprs and all — strip addresses here too (see _signature).
+    return re.sub(r" at 0x[0-9a-f]+", "", inspect.getdoc(obj) or "")
 
 
 def _public_members(mod):

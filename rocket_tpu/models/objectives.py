@@ -70,14 +70,30 @@ def lm_cross_entropy(
     logits_key: str = "logits",
     tokens_key: str = "tokens",
     mask_key: Optional[str] = "loss_mask",
+    nll_key: Optional[str] = "token_nll",
 ) -> Callable[[Any], Any]:
     """Next-token LM loss: logits[:, :-1] vs tokens[:, 1:], honoring an
-    optional per-token mask (padding / prompt masking)."""
+    optional per-token mask (padding / prompt masking).
+
+    When the model ran with ``fused_ce`` (TransformerLM) the batch carries
+    pre-shifted per-token NLL (``nll_key`` = ``token_nll`` [B, S-1], f32)
+    instead of logits — the [B*S, vocab] tensor never existed;
+    masking/averaging is identical from there.  Pass ``nll_key=None`` to
+    always score ``logits_key`` (e.g. a multi-head setup where this
+    objective targets a different logits tensor)."""
 
     def fn(batch: Any):
-        logits = batch[logits_key][:, :-1].astype(jnp.float32)
-        targets = batch[tokens_key][:, 1:]
-        losses = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+        nll = None
+        if nll_key is not None and hasattr(batch, "get"):
+            nll = batch.get(nll_key)
+        if nll is not None:
+            losses = nll.astype(jnp.float32)
+        else:
+            logits = batch[logits_key][:, :-1].astype(jnp.float32)
+            targets = batch[tokens_key][:, 1:]
+            losses = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            )
         mask = None
         if mask_key is not None and hasattr(batch, "get"):
             mask = batch.get(mask_key)

@@ -70,6 +70,16 @@ class TransformerConfig:
     attention: str = "auto"  # 'auto' | 'dot' | 'flash' | 'ring'
     attention_block_q: int = 256
     attention_block_k: int = 512
+    # One [hidden, (H+2*KV)*D] projection instead of three separate q/k/v
+    # matmuls — at GPT-2 width the MXU prefers the single wider matmul.
+    # Changes the param tree (attn/qkv vs attn/{q,k,v}), so it is opt-in.
+    fused_qkv: bool = False
+    # Logits-free LM loss: emit per-token NLL (``batch['token_nll']``,
+    # consumed by objectives.lm_cross_entropy) straight from the tied
+    # embedding table via ops.fused_ce — the [B*S, vocab] logits tensor
+    # never exists in HBM. Requires tie_embeddings; no 'logits' key is
+    # produced in this mode (decode/generation is unaffected).
+    fused_ce: bool = False
     causal: bool = True  # False -> bidirectional encoder (ViT)
     remat: bool = False
     scan_layers: bool = False
@@ -190,9 +200,16 @@ class Attention(nn.Module):
             lora_alpha=cfg.lora_alpha,
             name=name,
         )
-        q = dense(H * D, "q")(x).reshape(B, S, H, D)
-        k = dense(KV * D, "k")(x).reshape(B, S, KV, D)
-        v = dense(KV * D, "v")(x).reshape(B, S, KV, D)
+        if cfg.fused_qkv:
+            qkv = dense((H + 2 * KV) * D, "qkv")(x)
+            q, k, v = jnp.split(qkv, [H * D, (H + KV) * D], axis=-1)
+            q = q.reshape(B, S, H, D)
+            k = k.reshape(B, S, KV, D)
+            v = v.reshape(B, S, KV, D)
+        else:
+            q = dense(H * D, "q")(x).reshape(B, S, H, D)
+            k = dense(KV * D, "k")(x).reshape(B, S, KV, D)
+            v = dense(KV * D, "v")(x).reshape(B, S, KV, D)
         q = constrain(q, "batch", "sequence", "heads", None)
         k = constrain(k, "batch", "sequence", "heads", None)
         v = constrain(v, "batch", "sequence", "heads", None)
@@ -477,15 +494,33 @@ class TransformerLM(nn.Module):
                 moe_aux = moe_aux + aux
 
         x = _Norm(cfg, name="ln_f")(x)
-        if cfg.tie_embeddings:
-            logits = embed.attend(x)
+        out = Attributes(batch)
+        if cfg.fused_ce and not decode:
+            if not cfg.tie_embeddings:
+                raise ValueError(
+                    "fused_ce computes NLL from the tied embedding table; "
+                    "set tie_embeddings=True (or keep the logits path)"
+                )
+            from rocket_tpu.ops.fused_ce import linear_cross_entropy
+
+            # Next-token shift here (x[t] predicts tokens[t+1]); the
+            # objective sees aligned [B, S-1] nll and applies masks only.
+            table = jnp.asarray(embed.embedding, x.dtype)
+            nll = linear_cross_entropy(
+                x[:, :-1].reshape(-1, cfg.hidden),
+                table,
+                tokens[:, 1:].reshape(-1),
+            )
+            out["token_nll"] = nll.reshape(B, S - 1)
         else:
-            logits = PDense(
-                cfg.vocab_size, logical_axes=("embed", "vocab"), name="head"
-            )(x)
-        logits = constrain(logits, "batch", "sequence", "vocab")
-        out = Attributes(batch) if hasattr(batch, "get") else Attributes(batch)
-        out[self.logits_key] = logits
+            if cfg.tie_embeddings:
+                logits = embed.attend(x)
+            else:
+                logits = PDense(
+                    cfg.vocab_size, logical_axes=("embed", "vocab"), name="head"
+                )(x)
+            logits = constrain(logits, "batch", "sequence", "vocab")
+            out[self.logits_key] = logits
         if cfg.n_experts > 0:
             # Blackboard contract: downstream Loss(moe_aux_loss()) trains
             # against it (rocket_tpu.models.moe).

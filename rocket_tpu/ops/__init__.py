@@ -1,5 +1,12 @@
 from rocket_tpu.ops.attention import attend, dot_attention
 from rocket_tpu.ops.flash import flash_attention
+from rocket_tpu.ops.fused_ce import linear_cross_entropy
 from rocket_tpu.ops.ring import ring_attention
 
-__all__ = ["attend", "dot_attention", "flash_attention", "ring_attention"]
+__all__ = [
+    "attend",
+    "dot_attention",
+    "flash_attention",
+    "linear_cross_entropy",
+    "ring_attention",
+]

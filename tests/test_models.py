@@ -73,6 +73,94 @@ def test_transformer_gpt2_style(devices):
     mod.destroy()
 
 
+def test_transformer_fused_qkv_matches_unfused(devices):
+    """fused_qkv is a layout change only: transplanting the three separate
+    q/k/v kernels (concatenated) into the fused projection must reproduce
+    the unfused logits exactly."""
+    cfg = TransformerConfig.tiny(n_kv_heads=2, attention="dot")
+    cfg_f = TransformerConfig.tiny(n_kv_heads=2, attention="dot", fused_qkv=True)
+    batch = _lm_batch(B=2, S=64)
+    m, m_f = TransformerLM(cfg), TransformerLM(cfg_f)
+    import flax.linen as nn
+
+    vs = nn.meta.unbox(m.init(jax.random.PRNGKey(0), batch))
+
+    def fuse(params):
+        params = jax.tree_util.tree_map(lambda x: x, params)  # copy
+        for blk in [k for k in params if k.startswith("block_")]:
+            attn = params[blk]["attn"]
+            qkv = jnp.concatenate(
+                [attn.pop("q")["kernel"], attn.pop("k")["kernel"],
+                 attn.pop("v")["kernel"]], axis=-1,
+            )
+            attn["qkv"] = {"kernel": qkv}
+        return params
+
+    fused_params = fuse(
+        jax.tree_util.tree_map(lambda x: x, vs["params"])
+    )
+    out = m.apply(vs, batch)["logits"]
+    out_f = m_f.apply({"params": fused_params}, batch)["logits"]
+    np.testing.assert_allclose(
+        np.asarray(out), np.asarray(out_f), atol=1e-5, rtol=1e-5
+    )
+
+
+def test_transformer_fused_qkv_trains(devices):
+    runtime = rt.Runtime(mesh=MeshSpec(data=2, fsdp=2, tensor=2))
+    cfg = TransformerConfig.tiny(fused_qkv=True)
+    mod = _train_module(TransformerLM(cfg), lm_cross_entropy(), runtime)
+    batch = jax.device_put(_lm_batch(), runtime.batch_sharding(ndim=2))
+    losses = _run_steps(mod, batch)
+    assert losses[-1] < losses[0]
+    mod.destroy()
+
+
+def test_transformer_fused_ce_matches_logits_path(devices):
+    """fused_ce: the loss computed from token_nll (logits never built)
+    equals the logits-path loss, and so do the parameter gradients."""
+    from rocket_tpu.models.objectives import lm_cross_entropy as lm_ce
+
+    base = dict(tie_embeddings=True, positions="learned", attention="dot")
+    cfg = TransformerConfig.tiny(**base)
+    cfg_f = TransformerConfig.tiny(fused_ce=True, **base)
+    batch = _lm_batch(B=2, S=64)
+    m, m_f = TransformerLM(cfg), TransformerLM(cfg_f)
+    import flax.linen as nn
+
+    vs = nn.meta.unbox(m.init(jax.random.PRNGKey(0), batch))
+    loss_fn = lm_ce()
+
+    def loss_logits(params):
+        return loss_fn(m.apply({"params": params}, batch))
+
+    def loss_fused(params):
+        out = m_f.apply({"params": params}, batch)
+        assert "logits" not in out and "token_nll" in out
+        return loss_fn(out)
+
+    l0, g0 = jax.value_and_grad(loss_logits)(vs["params"])
+    l1, g1 = jax.value_and_grad(loss_fused)(vs["params"])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    flat0 = jax.tree_util.tree_leaves_with_path(g0)
+    flat1 = dict(jax.tree_util.tree_leaves_with_path(g1))
+    for path, leaf in flat0:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat1[path]), atol=2e-5, rtol=1e-4,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
+        )
+
+
+def test_transformer_fused_ce_trains_sharded(devices):
+    runtime = rt.Runtime(mesh=MeshSpec(data=2, fsdp=2, tensor=2))
+    cfg = TransformerConfig.tiny(tie_embeddings=True, fused_ce=True)
+    mod = _train_module(TransformerLM(cfg), lm_cross_entropy(), runtime)
+    batch = jax.device_put(_lm_batch(), runtime.batch_sharding(ndim=2))
+    losses = _run_steps(mod, batch)
+    assert losses[-1] < losses[0]
+    mod.destroy()
+
+
 def test_transformer_gqa_scan_remat(devices):
     runtime = rt.Runtime()
     cfg = TransformerConfig.tiny(n_kv_heads=2, scan_layers=True, remat=True)
