@@ -32,11 +32,9 @@ import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-# Honor an explicit CPU request even though the axon plugin's sitecustomize
-# already imported jax (env alone is too late; backend choice is still lazy,
-# so flipping the config works — same pattern as tests/conftest.py).
-if os.environ.get("JAX_PLATFORMS") == "cpu":
-    jax.config.update("jax_platforms", "cpu")
+from rocket_tpu.utils.platform import honor_cpu_request  # noqa: E402
+
+honor_cpu_request()
 
 
 def init_devices(timeout_s: float = 120.0, attempts: int = 3):

@@ -18,6 +18,10 @@ import sys
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+from rocket_tpu.utils.platform import honor_cpu_request  # noqa: E402
+
+honor_cpu_request()
+
 import numpy as np
 import optax
 
@@ -39,21 +43,27 @@ def main():
     parser.add_argument("--epochs", type=int, default=2)
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--accum", type=int, default=2)
+    parser.add_argument(
+        "--fused", action="store_true",
+        help="fused_qkv + fused_ce (logits-free loss) — the tuned "
+             "single-chip layout from bench.py",
+    )
     args = parser.parse_args()
 
+    fused = dict(fused_qkv=True, fused_ce=True) if args.fused else {}
     if args.data:
         data = {"tokens": np.load(args.data).astype(np.int32)}
         vocab = int(data["tokens"].max()) + 1
-        cfg = TransformerConfig.gpt2_124m()
+        cfg = TransformerConfig.gpt2_124m(**fused)
         assert vocab <= cfg.vocab_size
     elif args.tiny:
         cfg = TransformerConfig.tiny(
             norm="layernorm", mlp="gelu", positions="learned",
-            tie_embeddings=True, use_bias=True,
+            tie_embeddings=True, use_bias=True, **fused,
         )
         data = synthetic_lm_tokens(n_docs=256, seq_len=128, vocab=cfg.vocab_size)
     else:
-        cfg = TransformerConfig.gpt2_124m()
+        cfg = TransformerConfig.gpt2_124m(**fused)
         data = synthetic_lm_tokens(n_docs=256, seq_len=512, vocab=512)
 
     schedule = optax.warmup_cosine_decay_schedule(

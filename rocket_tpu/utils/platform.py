@@ -1,0 +1,23 @@
+"""Backend-selection helpers.
+
+On machines where a TPU plugin's ``sitecustomize`` imports jax at
+interpreter start, setting ``JAX_PLATFORMS=cpu`` in the environment is
+too late to take effect the normal way — but backend *selection* stays
+lazy until the first device query, so flipping the config still works.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def honor_cpu_request() -> None:
+    """Make ``JAX_PLATFORMS=cpu`` effective even when jax was pre-imported.
+
+    Call before the first ``jax.devices()`` / array op.  No-op unless the
+    environment explicitly asks for cpu.
+    """
+    if os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
