@@ -80,6 +80,8 @@ class TransformerConfig:
     # never exists in HBM. Requires tie_embeddings; no 'logits' key is
     # produced in this mode (decode/generation is unaffected).
     fused_ce: bool = False
+    # Tokens per fused-CE chunk; peak transient memory is chunk * vocab f32.
+    fused_ce_chunk: int = 1024
     causal: bool = True  # False -> bidirectional encoder (ViT)
     remat: bool = False
     scan_layers: bool = False
@@ -510,6 +512,7 @@ class TransformerLM(nn.Module):
                 x[:, :-1].reshape(-1, cfg.hidden),
                 table,
                 tokens[:, 1:].reshape(-1),
+                chunk_size=cfg.fused_ce_chunk,
             )
             out["token_nll"] = nll.reshape(B, S - 1)
         else:

@@ -161,6 +161,27 @@ def test_transformer_fused_ce_trains_sharded(devices):
     mod.destroy()
 
 
+@pytest.mark.parametrize(
+    "extra",
+    [dict(remat=True), dict(scan_layers=True),
+     dict(remat=True, scan_layers=True, fused_qkv=True)],
+    ids=["remat", "scan", "remat+scan+fused_qkv"],
+)
+def test_transformer_fused_ce_composes(devices, extra):
+    """fused_ce sits outside the block stack, so it must compose with the
+    memory layouts (remat / scan) and fused_qkv; chunk size that does not
+    divide the token count exercises the ragged tail."""
+    runtime = rt.Runtime(mesh=MeshSpec(data=2, tensor=2, fsdp=2))
+    cfg = TransformerConfig.tiny(
+        tie_embeddings=True, fused_ce=True, fused_ce_chunk=48, **extra
+    )
+    mod = _train_module(TransformerLM(cfg), lm_cross_entropy(), runtime)
+    batch = jax.device_put(_lm_batch(), runtime.batch_sharding(ndim=2))
+    losses = _run_steps(mod, batch, n=3)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    mod.destroy()
+
+
 def test_transformer_gqa_scan_remat(devices):
     runtime = rt.Runtime()
     cfg = TransformerConfig.tiny(n_kv_heads=2, scan_layers=True, remat=True)
