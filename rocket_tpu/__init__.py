@@ -32,6 +32,7 @@ from rocket_tpu.observe import (
     ImageLogger,
     Meter,
     Metric,
+    Perplexity,
     Profiler,
     StatMetric,
     Throughput,
@@ -61,6 +62,7 @@ __all__ = [
     "ImageLogger",
     "Meter",
     "Metric",
+    "Perplexity",
     "Profiler",
     "StatMetric",
     "Throughput",

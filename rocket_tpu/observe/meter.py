@@ -145,6 +145,64 @@ class Accuracy(StatMetric):
         return {self._tag: float(stats["correct"]) / count}
 
 
+class Perplexity(StatMetric):
+    """LM eval perplexity = exp(mean per-token NLL), in in-step form.
+
+    Consumes the fused-CE path's pre-shifted ``token_nll`` when the model
+    produced it (``TransformerConfig.fused_ce`` — logits never exist), and
+    falls back to computing shifted CE from ``logits``/``tokens``
+    otherwise.  Honors ``loss_mask``/``_valid`` like the training
+    objective (``objectives.lm_cross_entropy``)."""
+
+    def __init__(
+        self,
+        tag: str = "perplexity",
+        logits_key: str = "logits",
+        tokens_key: str = "tokens",
+        mask_key: Optional[str] = "loss_mask",
+        nll_key: str = "token_nll",
+        **kwargs,
+    ) -> None:
+        super().__init__(tag=tag, **kwargs)
+        self._logits_key = logits_key
+        self._tokens_key = tokens_key
+        self._mask_key = mask_key
+        self._nll_key = nll_key
+
+    def stats(self, batch: Any) -> Dict[str, Any]:
+        import jax.numpy as jnp
+        import optax
+
+        nll = batch.get(self._nll_key) if hasattr(batch, "get") else None
+        if nll is None:
+            logits = batch[self._logits_key][:, :-1].astype(jnp.float32)
+            targets = batch[self._tokens_key][:, 1:]
+            nll = optax.softmax_cross_entropy_with_integer_labels(
+                logits, targets
+            )
+        nll = nll.astype(jnp.float32)
+        mask = None
+        if self._mask_key is not None and hasattr(batch, "get"):
+            mask = batch.get(self._mask_key)
+        if mask is not None:
+            mask = mask[:, 1:].astype(jnp.float32)
+        valid = batch.get("_valid") if hasattr(batch, "get") else None
+        if valid is not None:
+            valid = valid.astype(jnp.float32)[:, None]
+            mask = valid if mask is None else mask * valid
+        if mask is None:
+            mask = jnp.ones_like(nll)
+        mask = jnp.broadcast_to(mask, nll.shape)
+        return {"nll_sum": (nll * mask).sum(), "token_count": mask.sum()}
+
+    def finalize(self, stats: Dict[str, Any]) -> Dict[str, float]:
+        import math
+
+        count = max(float(stats["token_count"]), 1.0)
+        mean_nll = float(stats["nll_sum"]) / count
+        return {self._tag: math.exp(min(mean_nll, 50.0))}
+
+
 class Meter(Dispatcher):
     """Distributed eval metrics in one of two modes (see module docstring).
 

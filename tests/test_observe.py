@@ -261,3 +261,59 @@ class TestThroughput:
         assert "throughput" in attrs.looper.state
         tags = [t for rec in attrs.tracker.scalars for t in rec.data]
         assert "throughput/samples_per_sec" in tags
+
+
+class TestPerplexity:
+    """LM perplexity StatMetric: logits path vs token_nll (fused_ce) path."""
+
+    def _batches(self, with_nll):
+        import jax.numpy as jnp
+        import optax
+
+        rng = np.random.default_rng(7)
+        batches = []
+        for _ in range(3):
+            tokens = jnp.asarray(rng.integers(0, 32, size=(4, 16)), jnp.int32)
+            logits = jnp.asarray(rng.normal(size=(4, 16, 32)), jnp.float32)
+            b = rt.Attributes(tokens=tokens, logits=logits)
+            if with_nll:
+                b = rt.Attributes(
+                    tokens=tokens,
+                    token_nll=optax.softmax_cross_entropy_with_integer_labels(
+                        logits[:, :-1], tokens[:, 1:]
+                    ),
+                )
+            batches.append(b)
+        return batches
+
+    def _run(self, batches):
+        metric = rt.Perplexity()
+        meter = rt.Meter(capsules=[metric], mode="in_step")
+        attrs = rt.Attributes(
+            looper=rt.Attributes(grad_enabled=False, state=rt.Attributes())
+        )
+        meter.set(attrs)
+        for batch in batches:
+            attrs.batch = batch
+            meter.launch(attrs)
+        meter.reset(attrs)
+        return metric.last["perplexity"]
+
+    def test_matches_direct_computation(self, devices):
+        import optax
+
+        batches = self._batches(with_nll=False)
+        got = self._run(batches)
+        nlls = [
+            optax.softmax_cross_entropy_with_integer_labels(
+                b["logits"][:, :-1], b["tokens"][:, 1:]
+            )
+            for b in batches
+        ]
+        want = float(np.exp(np.concatenate([np.asarray(x).ravel() for x in nlls]).mean()))
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_nll_path_matches_logits_path(self, devices):
+        a = self._run(self._batches(with_nll=False))
+        b = self._run(self._batches(with_nll=True))
+        assert a == pytest.approx(b, rel=1e-5)
