@@ -81,8 +81,6 @@ def test_transformer_fused_qkv_matches_unfused(devices):
     cfg_f = TransformerConfig.tiny(n_kv_heads=2, attention="dot", fused_qkv=True)
     batch = _lm_batch(B=2, S=64)
     m, m_f = TransformerLM(cfg), TransformerLM(cfg_f)
-    import flax.linen as nn
-
     vs = nn.meta.unbox(m.init(jax.random.PRNGKey(0), batch))
 
     def fuse(params):
@@ -126,8 +124,6 @@ def test_transformer_fused_ce_matches_logits_path(devices):
     cfg_f = TransformerConfig.tiny(fused_ce=True, **base)
     batch = _lm_batch(B=2, S=64)
     m, m_f = TransformerLM(cfg), TransformerLM(cfg_f)
-    import flax.linen as nn
-
     vs = nn.meta.unbox(m.init(jax.random.PRNGKey(0), batch))
     loss_fn = lm_ce()
 
@@ -180,6 +176,35 @@ def test_transformer_fused_ce_composes(devices, extra):
     losses = _run_steps(mod, batch, n=3)
     assert np.isfinite(losses).all() and losses[-1] < losses[0]
     mod.destroy()
+
+
+def test_transformer_scan_matches_unrolled(devices):
+    """scan_layers is a layout change only: stacking the unrolled blocks'
+    params along a leading 'layers' axis must reproduce the unrolled
+    logits exactly (backs the docs/performance.md claim that the scan
+    LAYOUT is sound and any TPU-backend scan anomaly is a backend issue)."""
+
+    base = dict(attention="dot", positions="learned", tie_embeddings=True)
+    cfg_u = TransformerConfig.tiny(n_kv_heads=2, **base)
+    cfg_s = TransformerConfig.tiny(n_kv_heads=2, scan_layers=True, **base)
+    batch = _lm_batch(B=2, S=64)
+    m_u, m_s = TransformerLM(cfg_u), TransformerLM(cfg_s)
+    vs = nn.meta.unbox(m_u.init(jax.random.PRNGKey(0), batch))
+
+    params = {k: v for k, v in vs["params"].items()}
+    block_keys = sorted(
+        (k for k in params if k.startswith("block_")),
+        key=lambda k: int(k.split("_")[1]),
+    )
+    blocks = [params.pop(k) for k in block_keys]
+    params["blocks"] = jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves, axis=0), *blocks
+    )
+    out_u = m_u.apply(vs, batch)["logits"]
+    out_s = m_s.apply({"params": params}, batch)["logits"]
+    np.testing.assert_allclose(
+        np.asarray(out_u), np.asarray(out_s), atol=2e-5, rtol=1e-5
+    )
 
 
 def test_transformer_gqa_scan_remat(devices):
