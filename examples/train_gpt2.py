@@ -2,12 +2,13 @@
 
 Demonstrates the LM pipeline: grad accumulation, cosine LR schedule with
 warmup, gradient clipping, checkpoint + resume, flash attention.  Data is a
-token file if given (``--data tokens.npy``: int32 ``[docs, seq]``), else a
-synthetic Markov stream so the script runs anywhere.  With ``--stream`` the
-token file is consumed as a length-free iterator (OpenWebText-style
-streaming; reference parity: torch IterableDataset through the loader,
-``rocket/core/dataset.py:100-126``) — resume still works because the
-stream replays deterministically.
+token file if given (``--data tokens.npy``: int32 ``[docs, seq]``; or
+``--data train.bin``: a flat uint16 token stream, memory-mapped via
+``TokenFileSource`` — the nanoGPT/OpenWebText layout), else a synthetic
+Markov stream so the script runs anywhere.  With ``--stream`` the token
+rows are consumed as a length-free iterator (reference parity: torch
+IterableDataset through the loader, ``rocket/core/dataset.py:100-126``) —
+resume still works because the stream replays deterministically.
 
     python examples/train_gpt2.py [--tiny] [--stream] [--resume path/to/ckpt]
 """
@@ -34,7 +35,11 @@ from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--tiny", action="store_true", help="tiny config (CPU-friendly)")
-    parser.add_argument("--data", type=str, default=None, help="int32 [docs, seq] .npy")
+    parser.add_argument(
+        "--data", type=str, default=None,
+        help="int32 [docs, seq] .npy, or a flat uint16 token stream .bin "
+             "(nanoGPT-style train.bin, memory-mapped)",
+    )
     parser.add_argument(
         "--stream", action="store_true",
         help="consume tokens as a length-free stream (IterableSource)",
@@ -51,20 +56,35 @@ def main():
     args = parser.parse_args()
 
     fused = dict(fused_qkv=True, fused_ce=True) if args.fused else {}
-    if args.data:
+    if args.data and args.data.endswith(".bin"):
+        # Flat uint16 token stream (nanoGPT-style train.bin), memory-mapped
+        # and sliced into rows — never loaded into RAM.
+        cfg = TransformerConfig.gpt2_124m(**fused)
+        data = None
+        bin_source = rt.TokenFileSource(args.data, seq_len=cfg.max_seq)
+        # Fail fast on tokenizer mismatch (uint16 holds ids the embedding
+        # would silently clip): scan a bounded sample of the memmap.
+        sample = bin_source._arr[: 2_000_000]
+        assert int(sample.max()) < cfg.vocab_size, (
+            f"token id {int(sample.max())} >= vocab {cfg.vocab_size}"
+        )
+    elif args.data:
         data = {"tokens": np.load(args.data).astype(np.int32)}
         vocab = int(data["tokens"].max()) + 1
         cfg = TransformerConfig.gpt2_124m(**fused)
         assert vocab <= cfg.vocab_size
+        bin_source = None
     elif args.tiny:
         cfg = TransformerConfig.tiny(
             norm="layernorm", mlp="gelu", positions="learned",
             tie_embeddings=True, use_bias=True, **fused,
         )
         data = synthetic_lm_tokens(n_docs=256, seq_len=128, vocab=cfg.vocab_size)
+        bin_source = None
     else:
         cfg = TransformerConfig.gpt2_124m(**fused)
         data = synthetic_lm_tokens(n_docs=256, seq_len=512, vocab=512)
+        bin_source = None
 
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=3e-4, warmup_steps=20,
@@ -81,7 +101,19 @@ def main():
             rt.Scheduler(schedule),
         ],
     )
-    if args.stream:
+    if bin_source is not None:
+        if args.stream:
+            # Length-free view of the same memmapped rows.
+            rows = bin_source
+
+            def bin_stream():
+                for i in range(len(rows)):
+                    yield rows[i]
+
+            source = rt.GeneratorSource(bin_stream)
+        else:
+            source = bin_source
+    elif args.stream:
         # Length-free streaming: rows leave the token store one at a time
         # (stand-in for an OpenWebText shard reader); the loader shards the
         # stream per host and shuffles through a seeded buffer.

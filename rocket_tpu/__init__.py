@@ -25,6 +25,7 @@ from rocket_tpu.data import (
     Dataset,
     GeneratorSource,
     IterableSource,
+    TokenFileSource,
 )
 from rocket_tpu.launch import Launcher, Looper, notebook_launch
 from rocket_tpu.observe import (
@@ -66,6 +67,7 @@ __all__ = [
     "Profiler",
     "StatMetric",
     "Throughput",
+    "TokenFileSource",
     "Module",
     "Optimizer",
     "Runtime",

@@ -7,6 +7,7 @@ from rocket_tpu.data.source import (
     IterableSource,
     MapSource,
     Source,
+    TokenFileSource,
 )
 
 __all__ = [
@@ -18,4 +19,5 @@ __all__ = [
     "IterableSource",
     "MapSource",
     "Source",
+    "TokenFileSource",
 ]

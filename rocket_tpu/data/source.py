@@ -121,3 +121,52 @@ class ConcatSource(Source):
             index += len(self)
         bucket = int(np.searchsorted(self._offsets, index, side="right")) - 1
         return self._sources[bucket][index - int(self._offsets[bucket])]
+
+
+class TokenFileSource(Source):
+    """Memory-mapped flat-token-file source for LM training — the
+    OpenWebText-style layout (one long int token stream on disk, e.g. the
+    public nanoGPT ``train.bin``) sliced into fixed-length rows without
+    loading the file into RAM.
+
+    Accepts ``.npy`` (via ``np.load(mmap_mode='r')``) or a raw binary of
+    ``dtype`` tokens.  ``stride`` < ``seq_len`` yields overlapping rows;
+    rows are materialized as small int32 copies only when indexed, so the
+    loader's shuffle/shard/prefetch machinery works unchanged on files far
+    larger than host memory.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        seq_len: int,
+        dtype: Any = np.uint16,
+        stride: Optional[int] = None,
+        key: str = "tokens",
+    ) -> None:
+        if str(path).endswith(".npy"):
+            arr = np.load(path, mmap_mode="r")
+        else:
+            arr = np.memmap(path, dtype=dtype, mode="r")
+        if arr.ndim != 1:
+            arr = arr.reshape(-1)
+        self._arr = arr
+        self._seq = int(seq_len)
+        self._stride = int(stride) if stride is not None else self._seq
+        if self._seq < 2 or self._stride < 1:
+            raise ValueError(f"bad seq_len={seq_len} / stride={stride}")
+        n = (len(arr) - self._seq) // self._stride + 1
+        self._length = max(0, int(n))
+        self._key = key
+
+    def __len__(self) -> int:
+        return self._length
+
+    def __getitem__(self, index: int) -> Any:
+        if index < 0:
+            index += self._length
+        if not 0 <= index < self._length:
+            raise IndexError(index)
+        start = index * self._stride
+        row = np.asarray(self._arr[start:start + self._seq], dtype=np.int32)
+        return {self._key: row}

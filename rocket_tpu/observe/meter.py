@@ -317,9 +317,19 @@ class Meter(Dispatcher):
         for key in self._keys:
             value = batch.get(key) if hasattr(batch, "get") else None
             if value is None:
+                hint = ""
+                if key == "logits" and hasattr(batch, "get") \
+                        and batch.get("token_nll") is not None:
+                    hint = (
+                        " — the model ran with fused_ce (logits are never "
+                        "built); score 'token_nll' instead (e.g. the "
+                        "Perplexity StatMetric) or turn fused_ce off for "
+                        "this eval"
+                    )
                 raise KeyError(
                     f"Meter: key {key!r} missing from batch "
                     f"(has {sorted(batch) if hasattr(batch, 'keys') else '?'})"
+                    f"{hint}"
                 )
             wanted[key] = value
         mask_value = batch.get(self._mask_key) if hasattr(batch, "get") else None

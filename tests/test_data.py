@@ -42,6 +42,35 @@ class TestSources:
         assert src[4]["y"] == 0  # first item of second source
         assert src[-1]["y"] == 5
 
+    def test_token_file_source(self, tmp_path):
+        from rocket_tpu.data.source import TokenFileSource
+
+        tokens = np.arange(100, dtype=np.uint16)
+        raw = tmp_path / "train.bin"
+        tokens.tofile(raw)
+        src = TokenFileSource(str(raw), seq_len=16)
+        assert len(src) == 6  # (100-16)//16 + 1
+        row = src[1]["tokens"]
+        assert row.dtype == np.int32
+        np.testing.assert_array_equal(row, np.arange(16, 32))
+
+        npy = tmp_path / "train.npy"
+        np.save(npy, tokens)
+        src2 = TokenFileSource(str(npy), seq_len=16, stride=8)
+        assert len(src2) == 11  # (100-16)//8 + 1
+        np.testing.assert_array_equal(src2[2]["tokens"], np.arange(16, 32))
+        np.testing.assert_array_equal(src2[-1]["tokens"], np.arange(80, 96))
+
+    def test_token_file_source_through_loader(self, tmp_path):
+        from rocket_tpu.data.source import TokenFileSource
+
+        raw = tmp_path / "t.bin"
+        np.arange(4096, dtype=np.uint16).tofile(raw)
+        src = TokenFileSource(str(raw), seq_len=64)
+        loader = DataLoader(src, batch_size=8, shuffle=True, seed=1)
+        batches = list(loader.iterate())
+        assert batches and batches[0]["tokens"].shape == (8, 64)
+
 
 class TestLoader:
     def test_batching_and_padding_mask(self):
