@@ -56,35 +56,29 @@ def main():
     args = parser.parse_args()
 
     fused = dict(fused_qkv=True, fused_ce=True) if args.fused else {}
+    data = bin_source = None
     if args.data and args.data.endswith(".bin"):
         # Flat uint16 token stream (nanoGPT-style train.bin), memory-mapped
-        # and sliced into rows — never loaded into RAM.
+        # and sliced into rows — never loaded into RAM; vocab_size= makes
+        # the source fail fast on tokenizer mismatch.
         cfg = TransformerConfig.gpt2_124m(**fused)
-        data = None
-        bin_source = rt.TokenFileSource(args.data, seq_len=cfg.max_seq)
-        # Fail fast on tokenizer mismatch (uint16 holds ids the embedding
-        # would silently clip): scan a bounded sample of the memmap.
-        sample = bin_source._arr[: 2_000_000]
-        assert int(sample.max()) < cfg.vocab_size, (
-            f"token id {int(sample.max())} >= vocab {cfg.vocab_size}"
+        bin_source = rt.TokenFileSource(
+            args.data, seq_len=cfg.max_seq, vocab_size=cfg.vocab_size
         )
     elif args.data:
         data = {"tokens": np.load(args.data).astype(np.int32)}
         vocab = int(data["tokens"].max()) + 1
         cfg = TransformerConfig.gpt2_124m(**fused)
         assert vocab <= cfg.vocab_size
-        bin_source = None
     elif args.tiny:
         cfg = TransformerConfig.tiny(
             norm="layernorm", mlp="gelu", positions="learned",
             tie_embeddings=True, use_bias=True, **fused,
         )
         data = synthetic_lm_tokens(n_docs=256, seq_len=128, vocab=cfg.vocab_size)
-        bin_source = None
     else:
         cfg = TransformerConfig.gpt2_124m(**fused)
         data = synthetic_lm_tokens(n_docs=256, seq_len=512, vocab=512)
-        bin_source = None
 
     schedule = optax.warmup_cosine_decay_schedule(
         init_value=0.0, peak_value=3e-4, warmup_steps=20,
@@ -104,11 +98,9 @@ def main():
     if bin_source is not None:
         if args.stream:
             # Length-free view of the same memmapped rows.
-            rows = bin_source
-
             def bin_stream():
-                for i in range(len(rows)):
-                    yield rows[i]
+                for i in range(len(bin_source)):
+                    yield bin_source[i]
 
             source = rt.GeneratorSource(bin_stream)
         else:

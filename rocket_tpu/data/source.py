@@ -143,6 +143,7 @@ class TokenFileSource(Source):
         dtype: Any = np.uint16,
         stride: Optional[int] = None,
         key: str = "tokens",
+        vocab_size: Optional[int] = None,
     ) -> None:
         if str(path).endswith(".npy"):
             arr = np.load(path, mmap_mode="r")
@@ -158,6 +159,16 @@ class TokenFileSource(Source):
         n = (len(arr) - self._seq) // self._stride + 1
         self._length = max(0, int(n))
         self._key = key
+        if vocab_size is not None:
+            # Fail fast on tokenizer mismatch (out-of-range ids would be
+            # silently clipped by the embedding gather): scan a bounded
+            # sample — full files can be many GB.
+            sample = np.asarray(self._arr[:2_000_000])
+            if sample.size and int(sample.max()) >= int(vocab_size):
+                raise ValueError(
+                    f"token id {int(sample.max())} >= vocab_size "
+                    f"{vocab_size} in {path!s}"
+                )
 
     def __len__(self) -> int:
         return self._length

@@ -119,3 +119,25 @@ def test_resnet50_shape_plan(devices):
     )
     assert 25.0e6 < count < 26.5e6, f"param count {count:,}"
     assert _spec_axes(specs) == set()  # replicated = the documented contract
+
+
+def test_gpt2_124m_fused_bench_layout_plan(devices):
+    """The tuned single-chip bench layout (bench.py GPT2_TUNE with
+    fused_qkv + fused_ce, padded vocab) at REAL scale: correct param count
+    and a clean sharding plan, traced at zero memory cost."""
+    cfg = TransformerConfig.gpt2_124m(
+        vocab_size=50304, fused_qkv=True, fused_ce=True,
+        attention_block_q=512, attention_block_k=1024,
+    )
+    batch_spec = {"tokens": jax.ShapeDtypeStruct((16, 1024), jnp.int32)}
+    abstract, specs, count = _abstract_plan(
+        TransformerLM(cfg), batch_spec, MeshSpec(data=4, tensor=2), devices
+    )
+    # 124M-class: tied embed (50304*768) + pos + 12 blocks
+    assert 1.2e8 < count < 1.3e8, f"param count {count:,}"
+    paths = [
+        jax.tree_util.keystr(p)
+        for p, _ in jax.tree_util.tree_leaves_with_path(specs)
+    ]
+    assert any("qkv" in p for p in paths), paths[:8]  # fused projection
+    assert not any("'head'" in p for p in paths)      # tied — no extra head

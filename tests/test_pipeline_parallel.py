@@ -313,3 +313,44 @@ def test_gpipe_batch_sharded_microbatches(devices):
         np.asarray(_chunk_apply(_layer, params, xs)),
         atol=1e-5,
     )
+
+
+def test_transformer_pipeline_with_fused_knobs(devices):
+    """Pipeline parallelism composes with fused_qkv and fused_ce (the
+    fused loss sits outside the pipelined block stack)."""
+    import rocket_tpu as rt
+    from rocket_tpu.models.objectives import lm_cross_entropy
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    runtime = rt.Runtime(mesh=MeshSpec(pipe=2, data=4))
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=4, n_heads=4, max_seq=32,
+        attention="dot", pipeline_microbatches=2,
+        tie_embeddings=True, fused_qkv=True, fused_ce=True, fused_ce_chunk=24,
+    )
+    mod = rt.Module(
+        TransformerLM(cfg),
+        capsules=[
+            rt.Loss(lm_cross_entropy(), name="lm"),
+            rt.Optimizer(learning_rate=1e-2),
+        ],
+    )
+    mod.bind(runtime)
+    mod.setup()
+    batch = jax.device_put(
+        {"tokens": jnp.asarray(
+            np.random.default_rng(0).integers(0, 64, size=(8, 16)), jnp.int32
+        )},
+        runtime.batch_sharding(ndim=2),
+    )
+    attrs = rt.Attributes(
+        batch=batch,
+        looper=rt.Attributes(grad_enabled=True, state=rt.Attributes()),
+    )
+    losses = []
+    for _ in range(4):
+        attrs.batch = batch
+        mod.launch(attrs)
+        losses.append(float(attrs.step_logs["lm"]))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
+    mod.destroy()
