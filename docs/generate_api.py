@@ -42,6 +42,7 @@ MODULES = {
     "rocket_tpu.data.source": "Data sources (map-style + streaming)",
     "rocket_tpu.parallel.pipeline": "GPipe pipeline parallelism",
     "rocket_tpu.models.moe": "Mixture-of-Experts (expert parallel)",
+    "rocket_tpu.models.seq2seq": "Encoder-decoder (T5-style) family",
     "rocket_tpu.engine.state": "TrainState pytree",
     "rocket_tpu.engine.step": "Jitted step builders",
     "rocket_tpu.engine.precision": "Mixed-precision policy",

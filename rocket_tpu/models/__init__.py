@@ -3,15 +3,18 @@ from rocket_tpu.models.layers import Embed, PDense, RMSNorm, apply_rope, rotary_
 from rocket_tpu.models.lenet import LeNet
 from rocket_tpu.models.lora import freeze_non_lora, freeze_where, lora_labels, merge_lora
 from rocket_tpu.models.resnet import ResNet, resnet18, resnet50
+from rocket_tpu.models.seq2seq import EncoderDecoder, Seq2SeqConfig
 from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
 from rocket_tpu.models.vit import ViT, ViTConfig
 
 __all__ = [
     "Embed",
+    "EncoderDecoder",
     "LeNet",
     "PDense",
     "RMSNorm",
     "ResNet",
+    "Seq2SeqConfig",
     "TransformerConfig",
     "TransformerLM",
     "ViT",

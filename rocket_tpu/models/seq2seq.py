@@ -1,0 +1,252 @@
+"""Encoder-decoder (T5-style) transformer family.
+
+The reference framework ships no models at all (models are user land,
+SURVEY §2); the TPU build's flagship is the decoder-only
+:class:`~rocket_tpu.models.transformer.TransformerLM`.  This module adds
+the encoder-decoder shape on the same building blocks (``Block``,
+``Attention``, ``MLP``, ``PDense``, logical-axis sharding), for
+translation/summarization-style seq2seq workloads:
+
+- encoder: bidirectional ``Block`` stack (``causal=False``) over
+  ``batch['inputs']``;
+- decoder: causal self-attention + cross-attention over the encoder
+  memory + MLP per block, teacher-forced on ``batch['targets']``;
+- one shared token embedding for both sides, tied as the LM head
+  (T5's layout);
+- training objective: reuse ``objectives.lm_cross_entropy(
+  tokens_key='targets')`` — the decoder predicts ``targets[:, 1:]`` from
+  ``targets[:, :-1]`` (the standard shift), with cross-attention over the
+  full input memory.
+
+Batch contract (blackboard): ``inputs`` int ``[B, S_in]``, ``targets``
+int ``[B, S_out]``, optional ``inputs_mask`` ``[B, S_in]`` (1 = real
+token; padding is masked out of cross-attention).  Output:
+``batch['logits']`` ``[B, S_out, vocab]``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.models.layers import Embed, PDense
+from rocket_tpu.models.transformer import (
+    MLP,
+    Attention,
+    Block,
+    TransformerConfig,
+    _Norm,
+)
+from rocket_tpu.parallel.context import constrain
+
+
+@dataclasses.dataclass(frozen=True)
+class Seq2SeqConfig:
+    """Encoder-decoder configuration (shared trunk settings on both sides).
+
+    Internally expands into two :class:`TransformerConfig` views —
+    ``encoder_config`` (bidirectional) and ``decoder_config`` (causal) —
+    so every trunk feature (GQA, RoPE/learned positions, SwiGLU/GELU,
+    norms, flash attention, fused_qkv) is inherited from the decoder-only
+    family.
+    """
+
+    vocab_size: int = 32000
+    hidden: int = 512
+    n_encoder_layers: int = 4
+    n_decoder_layers: int = 4
+    n_heads: int = 8
+    n_kv_heads: Optional[int] = None
+    ffn_dim: Optional[int] = None
+    max_seq: int = 1024
+    norm: str = "rmsnorm"
+    mlp: str = "swiglu"
+    positions: str = "rope"
+    rope_theta: float = 10000.0
+    dropout: float = 0.0
+    use_bias: bool = False
+    norm_eps: float = 1e-5
+    attention: str = "auto"
+    attention_block_q: int = 256
+    attention_block_k: int = 512
+    fused_qkv: bool = False
+
+    def _trunk(self, n_layers: int, causal: bool) -> TransformerConfig:
+        return TransformerConfig(
+            vocab_size=self.vocab_size,
+            hidden=self.hidden,
+            n_layers=n_layers,
+            n_heads=self.n_heads,
+            n_kv_heads=self.n_kv_heads,
+            ffn_dim=self.ffn_dim,
+            max_seq=self.max_seq,
+            norm=self.norm,
+            mlp=self.mlp,
+            positions=self.positions,
+            rope_theta=self.rope_theta,
+            dropout=self.dropout,
+            use_bias=self.use_bias,
+            norm_eps=self.norm_eps,
+            attention=self.attention,
+            attention_block_q=self.attention_block_q,
+            attention_block_k=self.attention_block_k,
+            fused_qkv=self.fused_qkv,
+            causal=causal,
+            tie_embeddings=True,
+        )
+
+    @property
+    def encoder_config(self) -> TransformerConfig:
+        return self._trunk(self.n_encoder_layers, causal=False)
+
+    @property
+    def decoder_config(self) -> TransformerConfig:
+        return self._trunk(self.n_decoder_layers, causal=True)
+
+    @classmethod
+    def tiny(cls, **kw) -> "Seq2SeqConfig":
+        base = dict(
+            vocab_size=256, hidden=64, n_encoder_layers=2,
+            n_decoder_layers=2, n_heads=4, max_seq=128,
+        )
+        base.update(kw)
+        return cls(**base)
+
+
+class CrossAttention(nn.Module):
+    """Decoder-side attention over the encoder memory.
+
+    The attention core is :func:`rocket_tpu.ops.attention.dot_attention`
+    with its key-only ``kv_mask`` (padding memory slots dropped): the
+    [S_out, S_in] score matrix is small relative to self-attention at the
+    lengths seq2seq runs at, and XLA fuses the mask+softmax — the flash
+    kernel's causal blocking buys nothing here.
+    """
+
+    config: TransformerConfig  # decoder trunk view
+
+    @nn.compact
+    def __call__(self, x, memory, memory_mask, train: bool):
+        from rocket_tpu.ops.attention import dot_attention
+
+        cfg = self.config
+        B, T, _ = x.shape
+        S = memory.shape[1]
+        H, KV, D = cfg.n_heads, cfg.kv_heads, cfg.head_dim
+        dense = lambda feat, name: PDense(  # noqa: E731
+            feat,
+            logical_axes=("embed", "heads"),
+            use_bias=cfg.use_bias,
+            name=name,
+        )
+        q = dense(H * D, "q")(x).reshape(B, T, H, D)
+        k = dense(KV * D, "k")(memory).reshape(B, S, KV, D)
+        v = dense(KV * D, "v")(memory).reshape(B, S, KV, D)
+        out = dot_attention(
+            q, k, v, causal=False, kv_mask=memory_mask
+        ).reshape(B, T, H * D)
+        out = PDense(
+            cfg.hidden,
+            logical_axes=("heads", "embed"),
+            use_bias=cfg.use_bias,
+            name="o",
+        )(out)
+        if cfg.dropout and train:
+            out = nn.Dropout(cfg.dropout, deterministic=False)(out)
+        return out
+
+
+class DecoderBlock(nn.Module):
+    """Causal self-attention + cross-attention + MLP (pre-norm residual)."""
+
+    config: TransformerConfig  # decoder trunk view
+
+    @nn.compact
+    def __call__(self, x, memory, memory_mask, positions, train: bool):
+        cfg = self.config
+        x = constrain(x, "batch", "sequence", "act_embed")
+        x = x + Attention(cfg, name="self_attn")(
+            _Norm(cfg, name="ln1")(x), positions, None, train
+        )
+        x = x + CrossAttention(cfg, name="cross_attn")(
+            _Norm(cfg, name="ln2")(x), memory, memory_mask, train
+        )
+        x = x + MLP(cfg, name="mlp")(_Norm(cfg, name="ln3")(x), train)
+        return constrain(x, "batch", "sequence", "act_embed")
+
+
+class EncoderDecoder(nn.Module):
+    """Batch-rewriting seq2seq model: ``inputs, targets -> logits``."""
+
+    config: Seq2SeqConfig
+    inputs_key: str = "inputs"
+    targets_key: str = "targets"
+    logits_key: str = "logits"
+    mask_key: str = "inputs_mask"
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        cfg = self.config
+        enc_cfg, dec_cfg = cfg.encoder_config, cfg.decoder_config
+        inputs = batch[self.inputs_key]
+        targets = batch[self.targets_key]
+        mask = batch.get(self.mask_key) if hasattr(batch, "get") else None
+
+        embed = Embed(cfg.vocab_size, cfg.hidden, name="embed")
+
+        def positions_for(tokens):
+            B, S = tokens.shape
+            return jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+        def add_learned_positions(x, name):
+            if cfg.positions != "learned":
+                return x
+            table = self.param(
+                name,
+                nn.with_partitioning(
+                    nn.initializers.normal(0.02), (None, "embed")
+                ),
+                (cfg.max_seq, cfg.hidden),
+            )
+            return x + jnp.asarray(table, x.dtype)[None, : x.shape[1], :]
+
+        # -- encoder ----------------------------------------------------
+        x = add_learned_positions(embed(inputs), "enc_pos_embedding")
+        x = constrain(x, "batch", "sequence", "act_embed")
+        if cfg.dropout and train:
+            x = nn.Dropout(cfg.dropout, deterministic=False)(x)
+        enc_positions = positions_for(inputs)
+        # Padding isolation: the bidirectional encoder would otherwise mix
+        # padded positions into real ones; the segment mechanism (same
+        # machinery as packed sequences) confines attention to the real
+        # segment. Padded memory slots are then dropped by the decoder's
+        # cross-attention mask.
+        enc_segments = None if mask is None else mask.astype(jnp.int32)
+        for i in range(cfg.n_encoder_layers):
+            x, _ = Block(enc_cfg, name=f"enc_block_{i}")(
+                x, enc_positions, enc_segments, train
+            )
+        memory = _Norm(enc_cfg, name="enc_norm")(x)
+
+        # -- decoder ----------------------------------------------------
+        y = add_learned_positions(embed(targets), "dec_pos_embedding")
+        y = constrain(y, "batch", "sequence", "act_embed")
+        if cfg.dropout and train:
+            y = nn.Dropout(cfg.dropout, deterministic=False)(y)
+        dec_positions = positions_for(targets)
+        for i in range(cfg.n_decoder_layers):
+            y = DecoderBlock(dec_cfg, name=f"dec_block_{i}")(
+                y, memory, mask, dec_positions, train
+            )
+        y = _Norm(dec_cfg, name="dec_norm")(y)
+        logits = embed.attend(y)
+        logits = constrain(logits, "batch", "sequence", "vocab")
+
+        out = Attributes(batch)
+        out[self.logits_key] = logits
+        return out

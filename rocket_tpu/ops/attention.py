@@ -49,6 +49,7 @@ def dot_attention(
     segment_ids: Optional[Array] = None,
     scale: Optional[float] = None,
     q_offset: Optional[Array] = None,
+    kv_mask: Optional[Array] = None,
 ) -> Array:
     """Reference einsum attention. Computes logits in f32 for stability
     regardless of the compute dtype (bf16 inputs stay bf16 on the matmuls —
@@ -58,22 +59,33 @@ def dot_attention(
     within the key axis — the KV-cache decode case, where K/V span the
     whole cache (``[B, T, KV, D]``, zeros past the write frontier masked
     out causally) while q holds only the newest token(s).
+
+    ``kv_mask`` (``[B, S_k]``, 1 = attend) is a key-only padding mask —
+    the cross-attention case (q and k come from different sequences, so
+    ``segment_ids`` cannot express it).  K and Q lengths may differ when
+    it is used with ``causal=False``.  The fill value is a large finite
+    negative, not ``-inf``: a fully-masked row (an all-padding dummy
+    input in a wrap-around batch) then degrades to uniform weights
+    instead of a batch-poisoning softmax NaN.
     """
     B, S, H, D = q.shape
     k, v = _repeat_kv(k, v, H)
     scale = scale if scale is not None else D ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
+    neg = jnp.asarray(-0.7 * jnp.finfo(jnp.float32).max, logits.dtype)
     if causal:
         q_pos = jnp.arange(S)[:, None]
         if q_offset is not None:
             q_pos = q_pos + q_offset
         k_pos = jnp.arange(k.shape[1])[None, :]
         mask = q_pos >= k_pos
-        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+        logits = jnp.where(mask[None, None], logits, neg)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
-        logits = jnp.where(seg_mask[:, None], logits, -jnp.inf)
+        logits = jnp.where(seg_mask[:, None], logits, neg)
+    if kv_mask is not None:
+        logits = jnp.where(kv_mask[:, None, None, :].astype(bool), logits, neg)
     weights = jax.nn.softmax(logits, axis=-1).astype(v.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", weights, v)
 
