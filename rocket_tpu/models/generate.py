@@ -113,3 +113,61 @@ def generate(
         [toks.swapaxes(0, 1), tok[:, None]], axis=1
     )
     return jnp.concatenate([prompt, generated], axis=1)
+
+
+def generate_seq2seq(
+    model: Any,
+    params: Any,
+    inputs: jax.Array,
+    max_new_tokens: int,
+    bos_id: int,
+    inputs_mask: Optional[jax.Array] = None,
+    rng: Optional[jax.Array] = None,
+    temperature: float = 0.0,
+    top_k: Optional[int] = None,
+    pad_id: int = 0,
+) -> jax.Array:
+    """Autoregressive decoding for the encoder-decoder family.
+
+    The encoder runs ONCE (``model.apply(..., method='encode')``); the
+    decoder then re-runs over a static ``[B, 1 + max_new_tokens]`` target
+    buffer inside a ``lax.scan``, reading the logits at the frontier each
+    step — causal self-attention guarantees positions beyond the frontier
+    (still ``pad_id``) cannot influence it.  Static shapes throughout, so
+    the loop compiles once; the O(T) re-decode trades peak efficiency for
+    zero cache plumbing, the right call at seq2seq output lengths.
+
+    Returns ``[B, 1 + max_new_tokens]`` tokens (BOS first).
+    """
+    B = inputs.shape[0]
+    total = 1 + max_new_tokens
+    if total > model.config.max_seq:
+        raise ValueError(
+            f"1 + max_new_tokens = {total} exceeds max_seq "
+            f"{model.config.max_seq}"
+        )
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    variables = params if "params" in params else {"params": params}
+    memory = model.apply(
+        variables, inputs, inputs_mask, False, method="encode"
+    )
+    buf = jnp.full((B, total), pad_id, jnp.int32).at[:, 0].set(bos_id)
+
+    def step(carry, t):
+        buf, rng = carry
+        logits = model.apply(
+            variables, buf, memory, inputs_mask, False, method="decode"
+        )
+        logits_t = jax.lax.dynamic_slice_in_dim(logits, t, 1, axis=1)[:, 0]
+        rng, sub = jax.random.split(rng)
+        nxt = _sample(logits_t, sub, temperature, top_k)
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, nxt[:, None], t + 1, axis=1
+        )
+        return (buf, rng), None
+
+    (buf, _), _ = jax.lax.scan(
+        step, (buf, rng), jnp.arange(max_new_tokens)
+    )
+    return buf

@@ -180,8 +180,18 @@ class DecoderBlock(nn.Module):
         return constrain(x, "batch", "sequence", "act_embed")
 
 
+def _positions_for(tokens):
+    B, S = tokens.shape
+    return jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+
+
 class EncoderDecoder(nn.Module):
-    """Batch-rewriting seq2seq model: ``inputs, targets -> logits``."""
+    """Batch-rewriting seq2seq model: ``inputs, targets -> logits``.
+
+    Setup-style so generation can call :meth:`encode` ONCE and then
+    :meth:`decode` per step (``model.apply(vars, ..., method="encode")``);
+    the training path ``__call__`` composes the same two methods.
+    """
 
     config: Seq2SeqConfig
     inputs_key: str = "inputs"
@@ -189,64 +199,79 @@ class EncoderDecoder(nn.Module):
     logits_key: str = "logits"
     mask_key: str = "inputs_mask"
 
-    @nn.compact
-    def __call__(self, batch, train: bool = False):
+    def setup(self):
+        """Builds the shared embedding (+ learned position tables), the
+        encoder/decoder block stacks, final norms, and embedding dropout."""
         cfg = self.config
         enc_cfg, dec_cfg = cfg.encoder_config, cfg.decoder_config
-        inputs = batch[self.inputs_key]
-        targets = batch[self.targets_key]
-        mask = batch.get(self.mask_key) if hasattr(batch, "get") else None
-
-        embed = Embed(cfg.vocab_size, cfg.hidden, name="embed")
-
-        def positions_for(tokens):
-            B, S = tokens.shape
-            return jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-
-        def add_learned_positions(x, name):
-            if cfg.positions != "learned":
-                return x
-            table = self.param(
-                name,
-                nn.with_partitioning(
-                    nn.initializers.normal(0.02), (None, "embed")
-                ),
-                (cfg.max_seq, cfg.hidden),
+        self.embed = Embed(cfg.vocab_size, cfg.hidden, name="embed")
+        if cfg.dropout:
+            self.embed_dropout = nn.Dropout(cfg.dropout)
+        if cfg.positions == "learned":
+            init = nn.with_partitioning(
+                nn.initializers.normal(0.02), (None, "embed")
             )
-            return x + jnp.asarray(table, x.dtype)[None, : x.shape[1], :]
+            self.enc_pos_embedding = self.param(
+                "enc_pos_embedding", init, (cfg.max_seq, cfg.hidden)
+            )
+            self.dec_pos_embedding = self.param(
+                "dec_pos_embedding", init, (cfg.max_seq, cfg.hidden)
+            )
+        self.enc_blocks = [
+            Block(enc_cfg, name=f"enc_block_{i}")
+            for i in range(cfg.n_encoder_layers)
+        ]
+        self.dec_blocks = [
+            DecoderBlock(dec_cfg, name=f"dec_block_{i}")
+            for i in range(cfg.n_decoder_layers)
+        ]
+        self.enc_norm = _Norm(enc_cfg, name="enc_norm")
+        self.dec_norm = _Norm(dec_cfg, name="dec_norm")
 
-        # -- encoder ----------------------------------------------------
-        x = add_learned_positions(embed(inputs), "enc_pos_embedding")
+    def _with_positions(self, x, table_name):
+        if self.config.positions != "learned":
+            return x
+        table = getattr(self, table_name)
+        return x + jnp.asarray(table, x.dtype)[None, : x.shape[1], :]
+
+    def encode(self, inputs, mask=None, train: bool = False):
+        """Inputs ``[B, S_in]`` -> memory ``[B, S_in, hidden]``."""
+        cfg = self.config
+        x = self._with_positions(self.embed(inputs), "enc_pos_embedding")
         x = constrain(x, "batch", "sequence", "act_embed")
         if cfg.dropout and train:
-            x = nn.Dropout(cfg.dropout, deterministic=False)(x)
-        enc_positions = positions_for(inputs)
+            x = self.embed_dropout(x, deterministic=False)
         # Padding isolation: the bidirectional encoder would otherwise mix
         # padded positions into real ones; the segment mechanism (same
         # machinery as packed sequences) confines attention to the real
         # segment. Padded memory slots are then dropped by the decoder's
         # cross-attention mask.
-        enc_segments = None if mask is None else mask.astype(jnp.int32)
-        for i in range(cfg.n_encoder_layers):
-            x, _ = Block(enc_cfg, name=f"enc_block_{i}")(
-                x, enc_positions, enc_segments, train
-            )
-        memory = _Norm(enc_cfg, name="enc_norm")(x)
+        segments = None if mask is None else mask.astype(jnp.int32)
+        positions = _positions_for(inputs)
+        for block in self.enc_blocks:
+            x, _ = block(x, positions, segments, train)
+        return self.enc_norm(x)
 
-        # -- decoder ----------------------------------------------------
-        y = add_learned_positions(embed(targets), "dec_pos_embedding")
+    def decode(self, targets, memory, mask=None, train: bool = False):
+        """Teacher-forced decoder: ``[B, S_out]`` -> logits
+        ``[B, S_out, vocab]`` (causal over targets, cross-attending
+        memory with padded slots masked)."""
+        cfg = self.config
+        y = self._with_positions(self.embed(targets), "dec_pos_embedding")
         y = constrain(y, "batch", "sequence", "act_embed")
         if cfg.dropout and train:
-            y = nn.Dropout(cfg.dropout, deterministic=False)(y)
-        dec_positions = positions_for(targets)
-        for i in range(cfg.n_decoder_layers):
-            y = DecoderBlock(dec_cfg, name=f"dec_block_{i}")(
-                y, memory, mask, dec_positions, train
-            )
-        y = _Norm(dec_cfg, name="dec_norm")(y)
-        logits = embed.attend(y)
-        logits = constrain(logits, "batch", "sequence", "vocab")
+            y = self.embed_dropout(y, deterministic=False)
+        positions = _positions_for(targets)
+        for block in self.dec_blocks:
+            y = block(y, memory, mask, positions, train)
+        y = self.dec_norm(y)
+        logits = self.embed.attend(y)
+        return constrain(logits, "batch", "sequence", "vocab")
 
+    def __call__(self, batch, train: bool = False):
+        mask = batch.get(self.mask_key) if hasattr(batch, "get") else None
+        memory = self.encode(batch[self.inputs_key], mask, train)
+        logits = self.decode(batch[self.targets_key], memory, mask, train)
         out = Attributes(batch)
         out[self.logits_key] = logits
         return out
