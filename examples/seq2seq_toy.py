@@ -94,8 +94,10 @@ def main():
                            batch_size=128),
                 model,
                 rt.Meter(capsules=[metric], mode="in_step"),
+                rt.Tracker("jsonl"),
             ], grad_enabled=False),
         ],
+        tag="seq2seq-toy",
         num_epochs=args.epochs,
         mixed_precision="bf16",
     )
