@@ -95,6 +95,7 @@ def main():
             rt.Scheduler(schedule),
         ],
     )
+    eval_data = None
     if bin_source is not None:
         if args.stream:
             # Length-free view of the same memmapped rows.
@@ -117,18 +118,41 @@ def main():
 
         source = rt.GeneratorSource(row_stream)
     else:
+        # Hold out the last 5% of rows for the eval pass; train on the
+        # rest (fused_ce models score token_nll directly).
+        n_eval = max(1, len(data["tokens"]) // 20)
+        eval_data = {"tokens": data["tokens"][-n_eval:]}
+        data = {"tokens": data["tokens"][:-n_eval]}
         source = rt.ArraySource(data)
-    launcher = rt.Launcher(
-        capsules=[
+    loopers = [
+        rt.Looper(
+            capsules=[
+                rt.Dataset(source, batch_size=args.batch, shuffle=True),
+                model,
+                rt.Tracker("jsonl"),
+                rt.Checkpointer(save_every=50, keep_last=2),
+            ]
+        )
+    ]
+    if eval_data is not None:
+        # statefull=False: eval loop/data state is trivially re-derivable,
+        # and keeping it out of the checkpointable topology means
+        # checkpoints from the train-only script version still resume.
+        loopers.append(
             rt.Looper(
                 capsules=[
-                    rt.Dataset(source, batch_size=args.batch, shuffle=True),
+                    rt.Dataset(rt.ArraySource(eval_data),
+                               batch_size=args.batch, statefull=False),
                     model,
+                    rt.Meter(capsules=[rt.Perplexity()], mode="in_step"),
                     rt.Tracker("jsonl"),
-                    rt.Checkpointer(save_every=50, keep_last=2),
-                ]
+                ],
+                grad_enabled=False,
+                statefull=False,
             )
-        ],
+        )
+    launcher = rt.Launcher(
+        capsules=loopers,
         tag="gpt2",
         num_epochs=args.epochs,
         mixed_precision="bf16",
