@@ -293,7 +293,8 @@ def bench_vit_b16(n_steps, warmup):
 # chip is reachable.
 GPT2_TUNE = dict(batch=16, seq=1024, block_q=512, block_k=1024,
                  vocab=50304, scan_layers=False, remat=False,
-                 fused_qkv=False, fused_ce=False, ce_chunk=1024)
+                 fused_qkv=False, fused_ce=False, ce_chunk=1024,
+                 remat_policy="nothing")
 
 
 def bench_gpt2(n_steps, warmup, tune=None):
@@ -306,6 +307,7 @@ def bench_gpt2(n_steps, warmup, tune=None):
         attention_block_k=t["block_k"],
         scan_layers=t["scan_layers"],
         remat=t["remat"],
+        remat_policy=t["remat_policy"],
         fused_qkv=t["fused_qkv"],
         fused_ce=t["fused_ce"],
         fused_ce_chunk=t["ce_chunk"],
@@ -357,6 +359,7 @@ def sweep_gpt2(n_steps, warmup):
     grid.append({"fused_ce": True, "batch": 64})
     grid.append({"scan_layers": True})  # scan ablation
     grid.append({"remat": True})        # remat ablation
+    grid.append({"remat": True, "remat_policy": "dots"})
     # The grid is written against a fixed reference point, not the current
     # defaults — always include the default itself, and run each distinct
     # merged config once even when a knob's value coincides with GPT2_TUNE.

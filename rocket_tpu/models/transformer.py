@@ -84,6 +84,14 @@ class TransformerConfig:
     fused_ce_chunk: int = 1024
     causal: bool = True  # False -> bidirectional encoder (ViT)
     remat: bool = False
+    # Rematerialization policy (remat=True): what the checkpointed block
+    # may KEEP instead of recomputing in the backward pass.
+    #   'nothing'  — recompute everything (max memory savings, max FLOPs)
+    #   'dots'     — keep matmul outputs (jax checkpoint_dots; recompute
+    #                only the cheap elementwise ops — the usual TPU sweet
+    #                spot: matmuls are the expensive part of the fwd)
+    #   'dots_no_batch' — keep only batch-free matmuls (weights-stationary)
+    remat_policy: str = "nothing"
     scan_layers: bool = False
     lora_rank: int = 0
     lora_alpha: float = 16.0
@@ -434,6 +442,15 @@ class TransformerLM(nn.Module):
                 "unrolled layer layout: scan_layers=False, remat=False, "
                 "pipeline_microbatches=0"
             )
+        if cfg.remat and cfg.pipeline_microbatches > 0:
+            # PipelinedBlocks does not thread the remat wrap; rejecting the
+            # combination beats silently training without rematerialization
+            # at a batch size the user sized for remat.
+            raise ValueError(
+                "remat=True is not supported with pipeline_microbatches>0 "
+                "(the pipeline already bounds activation memory per "
+                "microbatch; set remat=False)"
+            )
         tokens = batch[self.tokens_key]
         B, S = tokens.shape
         given_positions = batch.get("positions") if hasattr(batch, "get") else None
@@ -465,8 +482,20 @@ class TransformerLM(nn.Module):
 
         block_cls = Block
         if cfg.remat:
+            policies = {
+                "nothing": None,  # jax default: save nothing
+                "dots": jax.checkpoint_policies.checkpoint_dots,
+                "dots_no_batch":
+                    jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+            }
+            if cfg.remat_policy not in policies:
+                raise ValueError(
+                    f"unknown remat_policy {cfg.remat_policy!r}; "
+                    f"choose from {sorted(policies)}"
+                )
             block_cls = nn.remat(
-                Block, static_argnums=(4,), prevent_cse=False
+                Block, static_argnums=(4,), prevent_cse=False,
+                policy=policies[cfg.remat_policy],
             )
         if cfg.pipeline_microbatches > 0:
             x = PipelinedBlocks(cfg, name="pipeline")(

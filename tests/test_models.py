@@ -715,3 +715,29 @@ def test_seq2seq_dropout_trains(devices):
     out = m.apply(vs, batch, train=True,
                   rngs={"dropout": jax.random.PRNGKey(2)})
     assert bool(jnp.isfinite(out["logits"]).all())
+
+
+@pytest.mark.parametrize("policy", ["nothing", "dots", "dots_no_batch"])
+def test_transformer_remat_policies(devices, policy):
+    """Every remat policy produces the same (finite, decreasing) training
+    as plain remat — the policy only changes the recompute/memory trade."""
+    runtime = rt.Runtime()
+    cfg = TransformerConfig.tiny(remat=True, remat_policy=policy)
+    mod = _train_module(TransformerLM(cfg), lm_cross_entropy(), runtime)
+    losses = _run_steps(mod, _lm_batch(B=4, S=64), n=3)
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    mod.destroy()
+
+
+def test_transformer_remat_policy_unknown_rejected(devices):
+    cfg = TransformerConfig.tiny(remat=True, remat_policy="bogus")
+    with pytest.raises(ValueError, match="remat_policy"):
+        TransformerLM(cfg).init(
+            jax.random.PRNGKey(0), _lm_batch(B=1, S=32)
+        )
+
+
+def test_transformer_remat_pipeline_combo_rejected(devices):
+    cfg = TransformerConfig.tiny(remat=True, pipeline_microbatches=2)
+    with pytest.raises(ValueError, match="remat.*pipeline|pipeline.*remat"):
+        TransformerLM(cfg).init(jax.random.PRNGKey(0), _lm_batch(B=2, S=32))
