@@ -349,6 +349,17 @@ class Module(Dispatcher):
             return 0
         return int(self._state.step)
 
+    @property
+    def ema_params(self):
+        """The parameter-EMA tree maintained by
+        ``Optimizer(ema_decay=...)``, or None when EMA is off (see
+        :func:`rocket_tpu.core.optimizer.params_ema`)."""
+        if self._state is None:
+            return None
+        from rocket_tpu.core.optimizer import find_params_ema
+
+        return find_params_ema(self._state.opt_state)
+
     def state_dict(self) -> Attributes:
         if self._state is None:
             return Attributes()

@@ -15,12 +15,63 @@ synced steps and the effective-step counter.
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
 
 import optax
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
+
+
+class EmaState(NamedTuple):
+    """Optax state slot holding the parameter EMA tree."""
+
+    ema: Any
+
+
+def params_ema(decay: float) -> optax.GradientTransformation:
+    """Maintain an exponential moving average of the PARAMETERS inside the
+    optimizer state (``ema = decay * ema + (1-decay) * new_params``).
+
+    Chain it LAST: it assumes the incoming ``updates`` are the final
+    deltas, i.e. the new params are ``optax.apply_updates(params,
+    updates)``.  The EMA tree lives in ``opt_state`` so it shards,
+    donates, and checkpoints with the rest of the train state for free;
+    read it back with :func:`find_params_ema` (or
+    ``Module.ema_params``)."""
+    import jax
+    import jax.numpy as jnp
+
+    def init(params):
+        return EmaState(ema=jax.tree_util.tree_map(jnp.asarray, params))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("params_ema requires params in update()")
+        new_params = optax.apply_updates(params, updates)
+        new_ema = jax.tree_util.tree_map(
+            lambda e, p: decay * e + (1.0 - decay) * p,
+            state.ema,
+            new_params,
+        )
+        return updates, EmaState(ema=new_ema)
+
+    return optax.GradientTransformation(init, update)
+
+
+def find_params_ema(opt_state: Any) -> Optional[Any]:
+    """Extract the EMA parameter tree from a (nested) optax state, or None
+    when no :func:`params_ema` transform is in the chain."""
+    import jax
+
+    found = [
+        leaf.ema
+        for leaf in jax.tree_util.tree_leaves(
+            opt_state, is_leaf=lambda x: isinstance(x, EmaState)
+        )
+        if isinstance(leaf, EmaState)
+    ]
+    return found[0] if found else None
 
 
 class Optimizer(Capsule):
@@ -37,6 +88,11 @@ class Optimizer(Capsule):
         Base LR; ignored when a sibling ``Scheduler`` provides a schedule.
     grad_clip_norm:
         Optional global-norm clipping chained before the update.
+    ema_decay:
+        When set, a :func:`params_ema` transform is chained last — the
+        optimizer state carries an EMA of the parameters (sharded,
+        donated, and checkpointed with the train state); read it via
+        ``Module.ema_params``.
     """
 
     def __init__(
@@ -46,6 +102,7 @@ class Optimizer(Capsule):
         learning_rate: float = 1e-3,
         grad_clip_norm: Optional[float] = None,
         wrap: Optional[Callable[[optax.GradientTransformation], optax.GradientTransformation]] = None,
+        ema_decay: Optional[float] = None,
         tag: str = "lr",
         statefull: bool = True,
         priority: int = 1000,
@@ -58,6 +115,7 @@ class Optimizer(Capsule):
         self._learning_rate = learning_rate
         self._grad_clip_norm = grad_clip_norm
         self._wrap = wrap
+        self._ema_decay = ema_decay
         self._tx_kwargs = tx_kwargs
         self._tag = tag
         self._iter_idx = 0
@@ -85,6 +143,10 @@ class Optimizer(Capsule):
             # e.g. models.lora.freeze_non_lora — base weights frozen,
             # adapters train (the LoRA fine-tune contract).
             tx = self._wrap(tx)
+        if self._ema_decay is not None:
+            # LAST in the chain: params_ema assumes the updates it sees
+            # are the final deltas.
+            tx = optax.chain(tx, params_ema(self._ema_decay))
         return tx
 
     def constant_schedule(self) -> Callable[[int], Any]:

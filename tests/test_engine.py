@@ -140,3 +140,85 @@ def test_eval_step_returns_outputs():
     out, logs = eval_step(state, _batch())
     assert "pred" in out
     assert "loss" in logs
+
+
+class TestParamsEma:
+    def _module(self, decay):
+        import rocket_tpu as rt
+        from rocket_tpu.models.lenet import LeNet
+        from rocket_tpu.models.objectives import cross_entropy
+
+        runtime = rt.Runtime()
+        mod = rt.Module(
+            LeNet(num_classes=10),
+            capsules=[
+                rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                rt.Optimizer(learning_rate=1e-2, ema_decay=decay),
+            ],
+        )
+        mod.bind(runtime)
+        mod.setup()
+        return mod
+
+    def _batch(self):
+        rng = np.random.default_rng(0)
+        return {
+            "image": jnp.asarray(rng.normal(size=(8, 28, 28, 1)), jnp.float32),
+            "label": jnp.asarray(rng.integers(0, 10, size=(8,)), jnp.int32),
+        }
+
+    def _run(self, mod, n=3):
+        import rocket_tpu as rt
+
+        attrs = rt.Attributes(
+            looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
+        )
+        for _ in range(n):
+            attrs.batch = self._batch()
+            mod.launch(attrs)
+        return mod
+
+    def test_decay_zero_tracks_params_exactly(self, devices):
+        mod = self._run(self._module(decay=0.0))
+        ema = mod.ema_params
+        assert ema is not None
+        for a, b in zip(
+            jax.tree_util.tree_leaves(ema),
+            jax.tree_util.tree_leaves(mod.state.params),
+        ):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        mod.destroy()
+
+    def test_ema_lags_params(self, devices):
+        mod = self._run(self._module(decay=0.9))
+        ema = mod.ema_params
+        params = mod.state.params
+        diffs = [
+            float(jnp.abs(a - b).max())
+            for a, b in zip(
+                jax.tree_util.tree_leaves(ema),
+                jax.tree_util.tree_leaves(params),
+            )
+        ]
+        assert any(d > 0 for d in diffs)  # lags behind the live params
+        assert all(np.isfinite(d) for d in diffs)
+        mod.destroy()
+
+    def test_no_ema_returns_none(self, devices):
+        import rocket_tpu as rt
+        from rocket_tpu.models.lenet import LeNet
+        from rocket_tpu.models.objectives import cross_entropy
+
+        runtime = rt.Runtime()
+        mod = rt.Module(
+            LeNet(num_classes=10),
+            capsules=[
+                rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                rt.Optimizer(learning_rate=1e-2),
+            ],
+        )
+        mod.bind(runtime)
+        mod.setup()
+        self._run(mod)
+        assert mod.ema_params is None
+        mod.destroy()
