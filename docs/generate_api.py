@@ -44,6 +44,7 @@ MODULES = {
     "rocket_tpu.models.moe": "Mixture-of-Experts (expert parallel)",
     "rocket_tpu.models.seq2seq": "Encoder-decoder (T5-style) family",
     "rocket_tpu.engine.state": "TrainState pytree",
+    "rocket_tpu.engine.ema": "Parameter EMA (optax transform)",
     "rocket_tpu.engine.step": "Jitted step builders",
     "rocket_tpu.engine.precision": "Mixed-precision policy",
     "rocket_tpu.engine.adapter": "Model adapters",

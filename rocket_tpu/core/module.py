@@ -44,6 +44,7 @@ from rocket_tpu.core.capsule import Capsule
 from rocket_tpu.core.dispatcher import Dispatcher
 from rocket_tpu.engine.adapter import FlaxModel, ModelAdapter, state_shardings
 from rocket_tpu.engine.state import TrainState, param_count
+from rocket_tpu.engine.ema import reseed_ema
 from rocket_tpu.engine.step import build_eval_step, build_train_step
 from rocket_tpu.parallel.sharding import tree_shardings
 
@@ -94,6 +95,7 @@ class Module(Dispatcher):
         statefull: bool = True,
         priority: int = 1000,
         donate: bool = True,
+        eval_with_ema: bool = False,
         logger: Optional[Any] = None,
     ) -> None:
         super().__init__(
@@ -102,6 +104,7 @@ class Module(Dispatcher):
         self._adapter = _as_adapter(model)
         self._input_spec = input_spec
         self._donate = donate
+        self._eval_with_ema = eval_with_ema
         self._built = False
         self._state: Optional[TrainState] = None
         self._steps: Optional[dict] = None
@@ -159,6 +162,14 @@ class Module(Dispatcher):
                 "a Module hosts at most one Optimizer and one Scheduler"
             )
         self._schedule = schedulers[0].schedule if schedulers else None
+        if self._eval_with_ema and (
+            not optimizers or not optimizers[0].has_ema
+        ):
+            # Fail at setup, not at the first eval launch hours into a run.
+            raise RuntimeError(
+                "Module(eval_with_ema=True) requires an Optimizer with "
+                "ema_decay set"
+            )
         if optimizers:
             self._tx = optimizers[0].build_tx(self._schedule)
             optimizers[0].attach_schedule(
@@ -228,6 +239,12 @@ class Module(Dispatcher):
                 replacements = {"params": params}
                 if mutable is not None:
                     replacements["mutable"] = mutable
+                # Weights-only restore keeps the fresh optimizer state —
+                # re-seed any parameter EMA to the restored weights so
+                # eval_with_ema never runs the stale random-init snapshot.
+                replacements["opt_state"] = reseed_ema(
+                    self._state.opt_state, params
+                )
                 self._state = self._state.replace(**replacements)
             self._logger.info(
                 "materialized %s params (%d leaves) on mesh %s",
@@ -249,7 +266,8 @@ class Module(Dispatcher):
                 donate=self._donate,
             )
         self._eval_step = build_eval_step(
-            self._adapter.apply_fn, self._objectives, policy=policy
+            self._adapter.apply_fn, self._objectives, policy=policy,
+            use_ema=self._eval_with_ema,
         )
 
     def _restore_state(self, abstract_state: TrainState, shardings: Any) -> None:

@@ -15,7 +15,7 @@ synced steps and the effective-step counter.
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Optional
+from typing import Any, Callable, Optional
 
 import optax
 
@@ -23,55 +23,13 @@ from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
 
 
-class EmaState(NamedTuple):
-    """Optax state slot holding the parameter EMA tree."""
-
-    ema: Any
-
-
-def params_ema(decay: float) -> optax.GradientTransformation:
-    """Maintain an exponential moving average of the PARAMETERS inside the
-    optimizer state (``ema = decay * ema + (1-decay) * new_params``).
-
-    Chain it LAST: it assumes the incoming ``updates`` are the final
-    deltas, i.e. the new params are ``optax.apply_updates(params,
-    updates)``.  The EMA tree lives in ``opt_state`` so it shards,
-    donates, and checkpoints with the rest of the train state for free;
-    read it back with :func:`find_params_ema` (or
-    ``Module.ema_params``)."""
-    import jax
-    import jax.numpy as jnp
-
-    def init(params):
-        return EmaState(ema=jax.tree_util.tree_map(jnp.asarray, params))
-
-    def update(updates, state, params=None):
-        if params is None:
-            raise ValueError("params_ema requires params in update()")
-        new_params = optax.apply_updates(params, updates)
-        new_ema = jax.tree_util.tree_map(
-            lambda e, p: decay * e + (1.0 - decay) * p,
-            state.ema,
-            new_params,
-        )
-        return updates, EmaState(ema=new_ema)
-
-    return optax.GradientTransformation(init, update)
-
-
-def find_params_ema(opt_state: Any) -> Optional[Any]:
-    """Extract the EMA parameter tree from a (nested) optax state, or None
-    when no :func:`params_ema` transform is in the chain."""
-    import jax
-
-    found = [
-        leaf.ema
-        for leaf in jax.tree_util.tree_leaves(
-            opt_state, is_leaf=lambda x: isinstance(x, EmaState)
-        )
-        if isinstance(leaf, EmaState)
-    ]
-    return found[0] if found else None
+# Public API re-export: the implementation lives in engine.ema so the
+# engine layer (step builders) never imports upward into core.
+from rocket_tpu.engine.ema import (  # noqa: F401
+    EmaState,
+    find_params_ema,
+    params_ema,
+)
 
 
 class Optimizer(Capsule):
@@ -122,6 +80,13 @@ class Optimizer(Capsule):
         self._log_schedule: Optional[Callable[[int], Any]] = None
 
     # -- step construction (called by parent Module at setup) ----------------
+
+    @property
+    def has_ema(self) -> bool:
+        """True when this optimizer maintains a parameter EMA
+        (``ema_decay`` was set) — the contract ``Module(eval_with_ema=
+        True)`` checks at setup."""
+        return self._ema_decay is not None
 
     def build_tx(
         self, schedule: Optional[optax.Schedule] = None

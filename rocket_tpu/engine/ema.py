@@ -1,0 +1,81 @@
+"""Parameter EMA as an optax transform (engine layer).
+
+Lives in ``engine`` so ``engine.step`` can read the EMA without an upward
+dependency on ``core`` (core.optimizer re-exports the public names).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+
+class EmaState(NamedTuple):
+    """Optax state slot holding the parameter EMA tree."""
+
+    ema: Any
+
+
+def params_ema(decay: float) -> optax.GradientTransformation:
+    """Maintain an exponential moving average of the PARAMETERS inside the
+    optimizer state (``ema = decay * ema + (1-decay) * new_params``).
+
+    Chain it LAST: it assumes the incoming ``updates`` are the final
+    deltas, i.e. the new params are ``optax.apply_updates(params,
+    updates)``.  The EMA tree lives in ``opt_state`` so it shards,
+    donates, and checkpoints with the rest of the train state for free;
+    read it back with :func:`find_params_ema` (or ``Module.ema_params``).
+    """
+
+    def init(params):
+        return EmaState(ema=jax.tree_util.tree_map(jnp.asarray, params))
+
+    def update(updates, state, params=None):
+        if params is None:
+            raise ValueError("params_ema requires params in update()")
+        new_params = optax.apply_updates(params, updates)
+        new_ema = jax.tree_util.tree_map(
+            lambda e, p: decay * e + (1.0 - decay) * p,
+            state.ema,
+            new_params,
+        )
+        return updates, EmaState(ema=new_ema)
+
+    return optax.GradientTransformation(init, update)
+
+
+def _is_ema(leaf: Any) -> bool:
+    return isinstance(leaf, EmaState)
+
+
+def find_params_ema(opt_state: Any) -> Optional[Any]:
+    """Extract the EMA parameter tree from a (nested) optax state, or None
+    when no :func:`params_ema` transform is in the chain."""
+    found = [
+        leaf.ema
+        for leaf in jax.tree_util.tree_leaves(opt_state, is_leaf=_is_ema)
+        if _is_ema(leaf)
+    ]
+    return found[0] if found else None
+
+
+def reseed_ema(opt_state: Any, params: Any) -> Any:
+    """Replace every EMA slot with a fresh snapshot of ``params`` — used
+    after a weights-only restore, where the optimizer state keeps its
+    fresh init but the params jump to the restored values (evaluating the
+    stale random-init EMA would be silently wrong)."""
+
+    def replace(leaf):
+        if _is_ema(leaf):
+            # Real copies, not aliases: the donated train step would
+            # otherwise receive the same buffer as params AND ema
+            # ("attempt to donate the same buffer twice").
+            return EmaState(
+                ema=jax.tree_util.tree_map(jnp.copy, params)
+            )
+        return leaf
+
+    return jax.tree_util.tree_map(replace, opt_state, is_leaf=_is_ema)

@@ -42,6 +42,7 @@ import jax
 import jax.numpy as jnp
 import optax
 
+from rocket_tpu.engine.ema import find_params_ema
 from rocket_tpu.engine.precision import Policy
 from rocket_tpu.engine.state import TrainState
 
@@ -185,14 +186,29 @@ def build_eval_step(
     apply_fn: ApplyFn,
     objectives: Sequence[Objective] = (),
     policy: Policy = Policy(),
+    use_ema: bool = False,
 ) -> Callable[[TrainState, Any], Tuple[Any, Dict[str, Any]]]:
     """Jitted evaluation step: forward only (reference eval path — grads off
     make Loss/Optimizer/Scheduler no-ops, ``loss.py:88-89``,
     ``optimizer.py:128``).  Returns ``(batch_out, logs)`` — the augmented
-    batch feeds Meter/Metric capsules downstream (``meter.py:63-105``)."""
+    batch feeds Meter/Metric capsules downstream (``meter.py:63-105``).
+
+    ``use_ema=True`` evaluates with the parameter EMA maintained by
+    ``Optimizer(ema_decay=...)`` instead of the live params (the usual
+    inference weights for EMA-trained models); requires the transform to
+    be in the chain."""
 
     def eval_step(state: TrainState, batch: Any):
-        params = policy.cast_to_compute(state.params)
+        params = state.params
+        if use_ema:
+            ema = find_params_ema(state.opt_state)
+            if ema is None:
+                raise ValueError(
+                    "eval_with_ema: no params_ema transform in the "
+                    "optimizer chain — set Optimizer(ema_decay=...)"
+                )
+            params = ema
+        params = policy.cast_to_compute(params)
         batch_out, _ = apply_fn(params, state.mutable, state.rng, batch, False)
         logs: Dict[str, Any] = {}
         if objectives:

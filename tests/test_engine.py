@@ -222,3 +222,81 @@ class TestParamsEma:
         self._run(mod)
         assert mod.ema_params is None
         mod.destroy()
+
+
+def test_eval_with_ema_uses_ema_weights(devices):
+    """Module(eval_with_ema=True): the jitted eval step runs the EMA
+    weights — with decay=1.0 the EMA never moves off init, so eval logits
+    must equal the INITIAL model's, not the trained one's."""
+    import rocket_tpu as rt
+    from rocket_tpu.models.lenet import LeNet
+    from rocket_tpu.models.objectives import cross_entropy
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "image": jnp.asarray(rng.normal(size=(8, 28, 28, 1)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 10, size=(8,)), jnp.int32),
+    }
+
+    runtime = rt.Runtime()
+    mod = rt.Module(
+        LeNet(num_classes=10),
+        capsules=[
+            rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+            rt.Optimizer(learning_rate=5e-2, ema_decay=1.0),
+        ],
+        eval_with_ema=True,
+    )
+    mod.bind(runtime)
+    mod.setup()
+    attrs = rt.Attributes(
+        looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
+    )
+    attrs.batch = batch
+    mod.launch(attrs)  # materializes; EMA snapshot = init params
+    init_eval = rt.Attributes(
+        looper=rt.Attributes(grad_enabled=False, state=rt.Attributes())
+    )
+    init_eval.batch = dict(batch)
+    mod.launch(init_eval)
+    frozen_logits = np.asarray(init_eval.batch["logits"])
+
+    for _ in range(3):  # train more; live params move, EMA (decay=1) doesn't
+        attrs.batch = dict(batch)
+        mod.launch(attrs)
+    later_eval = rt.Attributes(
+        looper=rt.Attributes(grad_enabled=False, state=rt.Attributes())
+    )
+    later_eval.batch = dict(batch)
+    mod.launch(later_eval)
+    np.testing.assert_array_equal(
+        np.asarray(later_eval.batch["logits"]), frozen_logits
+    )
+    # sanity: live params DID move away from init
+    diffs = [
+        float(jnp.abs(a - b).max())
+        for a, b in zip(
+            jax.tree_util.tree_leaves(mod.state.params),
+            jax.tree_util.tree_leaves(mod.ema_params),
+        )
+    ]
+    assert any(d > 0 for d in diffs)
+    mod.destroy()
+
+
+def test_eval_with_ema_requires_decay(devices):
+    import rocket_tpu as rt
+    from rocket_tpu.models.lenet import LeNet
+    from rocket_tpu.models.objectives import cross_entropy
+
+    mod = rt.Module(
+        LeNet(num_classes=10),
+        capsules=[
+            rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+            rt.Optimizer(learning_rate=1e-2),  # no ema_decay
+        ],
+        eval_with_ema=True,
+    )
+    mod.bind(rt.Runtime())
+    with pytest.raises(RuntimeError, match="ema_decay"):
+        mod.setup()
