@@ -14,6 +14,7 @@ from __future__ import annotations
 
 from typing import Any, Callable, Optional
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -71,6 +72,8 @@ def lm_cross_entropy(
     tokens_key: str = "tokens",
     mask_key: Optional[str] = "loss_mask",
     nll_key: Optional[str] = "token_nll",
+    z_loss: float = 0.0,
+    lse_key: str = "token_lse",
 ) -> Callable[[Any], Any]:
     """Next-token LM loss: logits[:, :-1] vs tokens[:, 1:], honoring an
     optional per-token mask (padding / prompt masking).
@@ -80,20 +83,46 @@ def lm_cross_entropy(
     instead of logits — the [B*S, vocab] tensor never existed;
     masking/averaging is identical from there.  Pass ``nll_key=None`` to
     always score ``logits_key`` (e.g. a multi-head setup where this
-    objective targets a different logits tensor)."""
+    objective targets a different logits tensor).
+
+    ``z_loss`` > 0 adds the PaLM-style logit regularizer
+    ``z_loss * logsumexp(logits)^2`` per token (keeps the softmax
+    normalizer near 1, stabilizing large-vocab bf16 training); on the
+    fused path it reads the ``token_lse`` the model emitted."""
 
     def fn(batch: Any):
         nll = None
+        lse = None
         if nll_key is not None and hasattr(batch, "get"):
             nll = batch.get(nll_key)
         if nll is not None:
             losses = nll.astype(jnp.float32)
+            if z_loss > 0.0:
+                lse = batch.get(lse_key)
+                if lse is None:
+                    raise ValueError(
+                        f"z_loss with the fused-CE path needs the model's "
+                        f"{lse_key!r} output (TransformerLM emits "
+                        f"token_lse with fused_ce=True)"
+                    )
+                lse = lse.astype(jnp.float32)
         else:
             logits = batch[logits_key][:, :-1].astype(jnp.float32)
             targets = batch[tokens_key][:, 1:]
-            losses = optax.softmax_cross_entropy_with_integer_labels(
-                logits, targets
-            )
+            if z_loss > 0.0:
+                # One vocab reduction serves both terms:
+                # nll = lse - logits[target] (same formulation as fused_ce).
+                lse = jax.scipy.special.logsumexp(logits, axis=-1)
+                target_logit = jnp.take_along_axis(
+                    logits, targets[..., None], axis=-1
+                )[..., 0]
+                losses = lse - target_logit
+            else:
+                losses = optax.softmax_cross_entropy_with_integer_labels(
+                    logits, targets
+                )
+        if z_loss > 0.0:
+            losses = losses + z_loss * lse * lse
         mask = None
         if mask_key is not None and hasattr(batch, "get"):
             mask = batch.get(mask_key)

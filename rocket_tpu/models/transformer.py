@@ -537,13 +537,16 @@ class TransformerLM(nn.Module):
             # Next-token shift here (x[t] predicts tokens[t+1]); the
             # objective sees aligned [B, S-1] nll and applies masks only.
             table = jnp.asarray(embed.embedding, x.dtype)
-            nll = linear_cross_entropy(
+            nll, lse = linear_cross_entropy(
                 x[:, :-1].reshape(-1, cfg.hidden),
                 table,
                 tokens[:, 1:].reshape(-1),
                 chunk_size=cfg.fused_ce_chunk,
+                return_lse=True,
             )
             out["token_nll"] = nll.reshape(B, S - 1)
+            # z-loss input (objectives.lm_cross_entropy(z_loss=...)).
+            out["token_lse"] = lse.reshape(B, S - 1)
         else:
             if cfg.tie_embeddings:
                 logits = embed.attend(x)

@@ -39,7 +39,8 @@ def linear_cross_entropy(
     targets: jax.Array,
     *,
     chunk_size: int = 1024,
-) -> jax.Array:
+    return_lse: bool = False,
+):
     """Per-token NLL of ``softmax(x @ table^T)`` without full logits.
 
     Args:
@@ -48,9 +49,12 @@ def linear_cross_entropy(
       targets: ``[N]`` int target ids.
       chunk_size: tokens per chunk; peak extra memory is
         ``chunk_size * V`` f32.
+      return_lse: also return the per-token ``logsumexp(logits)`` (the
+        z-loss regularizer's input — PaLM-style ``z_loss * lse^2``).
 
     Returns:
-      ``[N]`` f32 per-token negative log-likelihood.
+      ``[N]`` f32 per-token negative log-likelihood (and, with
+      ``return_lse``, the ``[N]`` f32 logsumexp).
     """
     N, H = x.shape
     pad = (-N) % chunk_size
@@ -63,7 +67,7 @@ def linear_cross_entropy(
     ts = targets.reshape(-1, chunk_size)
 
     @jax.checkpoint
-    def chunk_nll(xc, tc):
+    def chunk_stats(xc, tc):
         # [c, V] f32 — exists only inside this map step.
         logits = jax.lax.dot_general(
             xc, table, (((1,), (1,)), ((), ())),
@@ -73,7 +77,10 @@ def linear_cross_entropy(
         tl = jnp.take_along_axis(
             logits, tc[:, None].astype(jnp.int32), axis=-1
         )[:, 0]
-        return lse - tl
+        return lse - tl, lse
 
-    nll = jax.lax.map(lambda args: chunk_nll(*args), (xs, ts))
-    return nll.reshape(-1)[:N]
+    nll, lse = jax.lax.map(lambda args: chunk_stats(*args), (xs, ts))
+    nll = nll.reshape(-1)[:N]
+    if return_lse:
+        return nll, lse.reshape(-1)[:N]
+    return nll

@@ -741,3 +741,49 @@ def test_transformer_remat_pipeline_combo_rejected(devices):
     cfg = TransformerConfig.tiny(remat=True, pipeline_microbatches=2)
     with pytest.raises(ValueError, match="remat.*pipeline|pipeline.*remat"):
         TransformerLM(cfg).init(jax.random.PRNGKey(0), _lm_batch(B=2, S=32))
+
+
+def test_lm_z_loss_parity_fused_vs_logits(devices):
+    """z_loss on the fused path (token_lse from the model) equals z_loss
+    computed from full logits — values AND parameter gradients."""
+    base = dict(tie_embeddings=True, positions="learned", attention="dot")
+    cfg = TransformerConfig.tiny(**base)
+    cfg_f = TransformerConfig.tiny(fused_ce=True, **base)
+    batch = _lm_batch(B=2, S=64)
+    m, m_f = TransformerLM(cfg), TransformerLM(cfg_f)
+    vs = nn.meta.unbox(m.init(jax.random.PRNGKey(0), batch))
+    loss_fn = lm_cross_entropy(z_loss=1e-3)
+
+    def loss_logits(params):
+        return loss_fn(m.apply({"params": params}, batch))
+
+    def loss_fused(params):
+        out = m_f.apply({"params": params}, batch)
+        assert "token_lse" in out
+        return loss_fn(out)
+
+    l0, g0 = jax.value_and_grad(loss_logits)(vs["params"])
+    l1, g1 = jax.value_and_grad(loss_fused)(vs["params"])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    flat1 = dict(jax.tree_util.tree_leaves_with_path(g1))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(g0):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat1[path]), atol=2e-5, rtol=1e-4,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
+        )
+
+
+def test_lm_z_loss_penalizes_large_logits(devices):
+    """The regularizer must grow with the softmax normalizer."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(size=(2, 16, 32)), jnp.float32)
+    tokens = jnp.asarray(rng.integers(0, 32, (2, 16)), jnp.int32)
+    plain = lm_cross_entropy()({"logits": logits, "tokens": tokens})
+    reg = lm_cross_entropy(z_loss=1e-2)({"logits": logits, "tokens": tokens})
+    reg_big = lm_cross_entropy(z_loss=1e-2)(
+        {"logits": logits * 10.0, "tokens": tokens}
+    )
+    assert float(reg) > float(plain)
+    assert float(reg_big) - float(
+        lm_cross_entropy()({"logits": logits * 10.0, "tokens": tokens})
+    ) > float(reg) - float(plain)
