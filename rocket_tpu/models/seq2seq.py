@@ -21,7 +21,9 @@ translation/summarization-style seq2seq workloads:
 Batch contract (blackboard): ``inputs`` int ``[B, S_in]``, ``targets``
 int ``[B, S_out]``, optional ``inputs_mask`` ``[B, S_in]`` (1 = real
 token; padding is masked out of cross-attention).  Output:
-``batch['logits']`` ``[B, S_out, vocab]``.
+``batch['logits']`` ``[B, S_out, vocab]`` — or, with
+``Seq2SeqConfig.fused_ce``, ``batch['token_nll']``/``'token_lse'``
+``[B, S_out-1]`` and no logits (the logits-free loss path).
 """
 
 from __future__ import annotations
@@ -75,6 +77,11 @@ class Seq2SeqConfig:
     attention_block_q: int = 256
     attention_block_k: int = 512
     fused_qkv: bool = False
+    # Logits-free decoder loss (same machinery as TransformerLM.fused_ce):
+    # __call__ emits batch['token_nll']/'token_lse' instead of logits; the
+    # encode()/decode() methods (and generation) are unaffected.
+    fused_ce: bool = False
+    fused_ce_chunk: int = 1024
 
     def _trunk(self, n_layers: int, causal: bool) -> TransformerConfig:
         return TransformerConfig(
@@ -252,10 +259,9 @@ class EncoderDecoder(nn.Module):
             x, _ = block(x, positions, segments, train)
         return self.enc_norm(x)
 
-    def decode(self, targets, memory, mask=None, train: bool = False):
-        """Teacher-forced decoder: ``[B, S_out]`` -> logits
-        ``[B, S_out, vocab]`` (causal over targets, cross-attending
-        memory with padded slots masked)."""
+    def _decode_hidden(self, targets, memory, mask, train: bool):
+        """Decoder stack up to (and including) the final norm — the
+        pre-unembed hidden states the fused-CE path consumes."""
         cfg = self.config
         y = self._with_positions(self.embed(targets), "dec_pos_embedding")
         y = constrain(y, "batch", "sequence", "act_embed")
@@ -264,14 +270,30 @@ class EncoderDecoder(nn.Module):
         positions = _positions_for(targets)
         for block in self.dec_blocks:
             y = block(y, memory, mask, positions, train)
-        y = self.dec_norm(y)
+        return self.dec_norm(y)
+
+    def decode(self, targets, memory, mask=None, train: bool = False):
+        """Teacher-forced decoder: ``[B, S_out]`` -> logits
+        ``[B, S_out, vocab]`` (causal over targets, cross-attending
+        memory with padded slots masked)."""
+        y = self._decode_hidden(targets, memory, mask, train)
         logits = self.embed.attend(y)
         return constrain(logits, "batch", "sequence", "vocab")
 
     def __call__(self, batch, train: bool = False):
+        cfg = self.config
         mask = batch.get(self.mask_key) if hasattr(batch, "get") else None
+        targets = batch[self.targets_key]
         memory = self.encode(batch[self.inputs_key], mask, train)
-        logits = self.decode(batch[self.targets_key], memory, mask, train)
         out = Attributes(batch)
-        out[self.logits_key] = logits
+        if cfg.fused_ce:
+            from rocket_tpu.ops.fused_ce import fused_ce_outputs
+
+            y = self._decode_hidden(targets, memory, mask, train)
+            table = jnp.asarray(self.embed.embedding, y.dtype)
+            out["token_nll"], out["token_lse"] = fused_ce_outputs(
+                y, table, targets, chunk_size=cfg.fused_ce_chunk
+            )
+        else:
+            out[self.logits_key] = self.decode(targets, memory, mask, train)
         return out

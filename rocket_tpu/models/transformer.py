@@ -532,21 +532,15 @@ class TransformerLM(nn.Module):
                     "fused_ce computes NLL from the tied embedding table; "
                     "set tie_embeddings=True (or keep the logits path)"
                 )
-            from rocket_tpu.ops.fused_ce import linear_cross_entropy
+            from rocket_tpu.ops.fused_ce import fused_ce_outputs
 
-            # Next-token shift here (x[t] predicts tokens[t+1]); the
-            # objective sees aligned [B, S-1] nll and applies masks only.
+            # Next-token shift inside the helper (x[t] predicts
+            # tokens[t+1]); the objective applies masks only.  token_lse
+            # is the z-loss input (lm_cross_entropy(z_loss=...)).
             table = jnp.asarray(embed.embedding, x.dtype)
-            nll, lse = linear_cross_entropy(
-                x[:, :-1].reshape(-1, cfg.hidden),
-                table,
-                tokens[:, 1:].reshape(-1),
-                chunk_size=cfg.fused_ce_chunk,
-                return_lse=True,
+            out["token_nll"], out["token_lse"] = fused_ce_outputs(
+                x, table, tokens, chunk_size=cfg.fused_ce_chunk
             )
-            out["token_nll"] = nll.reshape(B, S - 1)
-            # z-loss input (objectives.lm_cross_entropy(z_loss=...)).
-            out["token_lse"] = lse.reshape(B, S - 1)
         else:
             if cfg.tie_embeddings:
                 logits = embed.attend(x)

@@ -84,3 +84,22 @@ def linear_cross_entropy(
     if return_lse:
         return nll, lse.reshape(-1)[:N]
     return nll
+
+
+def fused_ce_outputs(hidden, table, tokens, *, chunk_size: int = 1024):
+    """Shared model-side wrapper: next-token-shifted per-token NLL + lse.
+
+    ``hidden`` ``[B, S, H]`` (post-final-norm), ``tokens`` ``[B, S]`` —
+    position t predicts ``tokens[t+1]``.  Returns ``(nll, lse)`` both
+    ``[B, S-1]`` f32, the ``token_nll``/``token_lse`` blackboard outputs
+    used by TransformerLM and EncoderDecoder ``fused_ce`` modes.
+    """
+    B, S, H = hidden.shape
+    nll, lse = linear_cross_entropy(
+        hidden[:, :-1].reshape(-1, H),
+        table,
+        tokens[:, 1:].reshape(-1),
+        chunk_size=chunk_size,
+        return_lse=True,
+    )
+    return nll.reshape(B, S - 1), lse.reshape(B, S - 1)

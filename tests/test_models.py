@@ -787,3 +787,39 @@ def test_lm_z_loss_penalizes_large_logits(devices):
     assert float(reg_big) - float(
         lm_cross_entropy()({"logits": logits * 10.0, "tokens": tokens})
     ) > float(reg) - float(plain)
+
+
+def test_seq2seq_fused_ce_matches_logits_path(devices):
+    """Seq2seq fused_ce parity: loss (with z_loss) and grads equal the
+    logits path, mirroring the LM family's contract."""
+    from rocket_tpu.models.seq2seq import EncoderDecoder, Seq2SeqConfig
+
+    base = dict(attention="dot")
+    cfg = Seq2SeqConfig.tiny(**base)
+    cfg_f = Seq2SeqConfig.tiny(fused_ce=True, fused_ce_chunk=24, **base)
+    rng = np.random.default_rng(5)
+    batch = {
+        "inputs": jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 12)), jnp.int32),
+        "targets": jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 10)), jnp.int32),
+    }
+    m, m_f = EncoderDecoder(cfg), EncoderDecoder(cfg_f)
+    vs = nn.meta.unbox(m.init(jax.random.PRNGKey(0), batch))
+    loss_fn = lm_cross_entropy(tokens_key="targets", z_loss=1e-3)
+
+    def loss_logits(params):
+        return loss_fn(m.apply({"params": params}, batch))
+
+    def loss_fused(params):
+        out = m_f.apply({"params": params}, batch)
+        assert "logits" not in out and "token_nll" in out
+        return loss_fn(out)
+
+    l0, g0 = jax.value_and_grad(loss_logits)(vs["params"])
+    l1, g1 = jax.value_and_grad(loss_fused)(vs["params"])
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    flat1 = dict(jax.tree_util.tree_leaves_with_path(g1))
+    for path, leaf in jax.tree_util.tree_leaves_with_path(g0):
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat1[path]), atol=2e-5, rtol=1e-4,
+            err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
+        )
