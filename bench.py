@@ -286,12 +286,11 @@ def bench_vit_b16(n_steps, warmup):
 # tiles the MXU cleanly (same trick as the public nanoGPT recipe); the
 # extra logits are never targeted by data (ids < 50257) and their FLOPs
 # ARE executed, so the analytical formula counts the padded size.
-# Defaults splice the two individually-strongest measured changes
-# (docs/performance.md ablations: blocks 512/1024 at bs8 = 0.426 MFU,
-# batch 16 at blocks 256/512 = 0.389) — the combination itself is still
-# unmeasured (TPU tunnel outage); re-measure and pin via --sweep when a
-# chip is reachable.
-GPT2_TUNE = dict(batch=16, seq=1024, block_q=512, block_k=1024,
+# Defaults = the best MEASURED configuration (docs/performance.md
+# ablations: blocks 512/1024 at bs8 = 0.426 MFU).  Stronger combinations
+# (bs16 × the same blocks, + fused_qkv/fused_ce) are plausible but
+# unmeasured; re-pin only after --sweep confirms them on a chip.
+GPT2_TUNE = dict(batch=8, seq=1024, block_q=512, block_k=1024,
                  vocab=50304, scan_layers=False, remat=False,
                  fused_qkv=False, fused_ce=False, ce_chunk=1024,
                  remat_policy="nothing")

@@ -161,14 +161,33 @@ class TokenFileSource(Source):
         self._key = key
         if vocab_size is not None:
             # Fail fast on tokenizer mismatch (out-of-range ids would be
-            # silently clipped by the embedding gather): scan a bounded
-            # sample — full files can be many GB.
-            sample = np.asarray(self._arr[:2_000_000])
-            if sample.size and int(sample.max()) >= int(vocab_size):
-                raise ValueError(
-                    f"token id {int(sample.max())} >= vocab_size "
-                    f"{vocab_size} in {path!s}"
-                )
+            # silently clipped by the embedding gather): scan bounded
+            # samples — full files can be many GB.  Head + tail + a strided
+            # middle sample catch corrupt/mismatched regions that start
+            # anywhere, not just in the first chunk.
+            chunk = 1_000_000
+            total = len(self._arr)
+            if total <= 8 * chunk:
+                # Small enough to scan exhaustively (<=16MB of sequential
+                # reads for uint16) — no blind spots.
+                spans = [(s, min(s + chunk, total))
+                         for s in range(0, total, chunk)]
+            else:
+                # Huge file: bound I/O at ~10MB of contiguous sequential
+                # windows (head, tail, quartiles).  Contiguous windows, not
+                # a strided scan — a stride faults one page per element
+                # (~GBs of random I/O on a cold 20GB memmap).
+                spans = [(0, chunk), (total - chunk, total)]
+                spans += [(int(total * f), int(total * f) + chunk)
+                          for f in (0.25, 0.5, 0.75)]
+            for start, stop in spans:
+                sample = np.asarray(self._arr[start:stop])
+                if sample.size and int(sample.max()) >= int(vocab_size):
+                    raise ValueError(
+                        f"token id {int(sample.max())} >= vocab_size "
+                        f"{vocab_size} in {path!s} "
+                        f"(offset range [{start}, {stop}))"
+                    )
 
     def __len__(self) -> int:
         return self._length

@@ -31,7 +31,11 @@ def params_ema(decay: float) -> optax.GradientTransformation:
     """
 
     def init(params):
-        return EmaState(ema=jax.tree_util.tree_map(jnp.asarray, params))
+        # Real copies, not aliases: jnp.asarray on a jax.Array is a no-op,
+        # and an EMA that shares buffers with state.params breaks the
+        # donated train step on TPU ("attempt to donate the same buffer
+        # twice") — same reason reseed_ema copies.
+        return EmaState(ema=jax.tree_util.tree_map(jnp.copy, params))
 
     def update(updates, state, params=None):
         if params is None:

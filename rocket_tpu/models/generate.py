@@ -146,6 +146,13 @@ def generate_seq2seq(
             f"1 + max_new_tokens = {total} exceeds max_seq "
             f"{model.config.max_seq}"
         )
+    if inputs.shape[1] > model.config.max_seq:
+        # With learned positions an over-length encoder input would
+        # silently gather clamped position embeddings instead of erroring.
+        raise ValueError(
+            f"encoder inputs length {inputs.shape[1]} exceeds max_seq "
+            f"{model.config.max_seq}"
+        )
     if rng is None:
         rng = jax.random.PRNGKey(0)
     variables = params if "params" in params else {"params": params}

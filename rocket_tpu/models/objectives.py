@@ -90,6 +90,20 @@ def lm_cross_entropy(
     normalizer near 1, stabilizing large-vocab bf16 training); on the
     fused path it reads the ``token_lse`` the model emitted."""
 
+    if logits_key != "logits" and nll_key == "token_nll":
+        # A custom logits_key targets a specific head; silently preferring
+        # the default-named fused-CE NLL (which belongs to the model's
+        # primary head) would score the wrong tensor.  A custom nll_key
+        # names this head's own fused NLL and stays allowed.
+        raise ValueError(
+            f"lm_cross_entropy(logits_key={logits_key!r}) with the default "
+            f"nll_key='token_nll': a non-default logits_key targets a "
+            f"specific logits tensor, but the primary head's fused-CE NLL "
+            f"(when present in the batch) would take precedence and score "
+            f"a different head. Pass nll_key=None to always score "
+            f"logits_key, or name this head's own NLL output explicitly."
+        )
+
     def fn(batch: Any):
         nll = None
         lse = None

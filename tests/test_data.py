@@ -71,6 +71,20 @@ class TestSources:
         batches = list(loader.iterate())
         assert batches and batches[0]["tokens"].shape == (8, 64)
 
+    def test_token_file_source_vocab_check_catches_tail(self, tmp_path):
+        # Corruption past the head sample must still fail fast: plant the
+        # out-of-range id only in the final tokens of a >1M-token file.
+        from rocket_tpu.data.source import TokenFileSource
+
+        arr = np.zeros(1_500_000, dtype=np.uint16)
+        arr[-1] = 60000
+        raw = tmp_path / "tail.bin"
+        arr.tofile(raw)
+        with pytest.raises(ValueError, match="vocab_size"):
+            TokenFileSource(str(raw), seq_len=16, vocab_size=50257)
+        # without vocab_size it loads fine
+        assert len(TokenFileSource(str(raw), seq_len=16)) > 0
+
 
 class TestLoader:
     def test_batching_and_padding_mask(self):

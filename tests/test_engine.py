@@ -204,6 +204,19 @@ class TestParamsEma:
         assert all(np.isfinite(d) for d in diffs)
         mod.destroy()
 
+    def test_init_copies_do_not_alias_params(self, devices):
+        # jnp.asarray would alias the param buffers; with the donated
+        # train step that is "attempt to donate the same buffer twice"
+        # on TPU (donation is a no-op on CPU, so only the aliasing itself
+        # is checkable here).
+        from rocket_tpu.engine.ema import params_ema
+
+        params = {"w": jnp.arange(4, dtype=jnp.float32)}
+        state = params_ema(0.9).init(params)
+        assert state.ema["w"] is not params["w"]
+        assert (state.ema["w"].unsafe_buffer_pointer()
+                != params["w"].unsafe_buffer_pointer())
+
     def test_no_ema_returns_none(self, devices):
         import rocket_tpu as rt
         from rocket_tpu.models.lenet import LeNet

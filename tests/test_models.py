@@ -823,3 +823,42 @@ def test_seq2seq_fused_ce_matches_logits_path(devices):
             np.asarray(leaf), np.asarray(flat1[path]), atol=2e-5, rtol=1e-4,
             err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}",
         )
+
+
+def test_lm_ce_custom_logits_key_rejects_fused_default(devices):
+    """A non-default logits_key targets a specific head; the fused-CE NLL
+    (batch['token_nll']) would silently take precedence and score a
+    different head — construction must fail unless nll_key=None."""
+    import pytest
+
+    with pytest.raises(ValueError, match="nll_key"):
+        lm_cross_entropy(logits_key="aux_logits")
+    # a coherent custom pairing (this head's OWN fused NLL) stays allowed
+    fn_pair = lm_cross_entropy(logits_key="aux_logits", nll_key="aux_nll")
+    paired = fn_pair({"aux_nll": jnp.full((2, 7), 0.5),
+                      "tokens": jnp.zeros((2, 8), jnp.int32)})
+    np.testing.assert_allclose(float(paired), 0.5, rtol=1e-6)
+    # explicit opt-out is the supported logits-only spelling
+    fn = lm_cross_entropy(logits_key="aux_logits", nll_key=None)
+    logits = jnp.zeros((2, 8, 16), jnp.float32)
+    tokens = jnp.zeros((2, 8), jnp.int32)
+    out = fn({"aux_logits": logits, "tokens": tokens,
+              "token_nll": jnp.full((2, 7), 99.0)})
+    assert float(out) < 10.0  # scored aux_logits, not the planted NLL
+
+
+def test_seq2seq_generate_rejects_overlong_encoder_input(devices):
+    """Encoder inputs longer than max_seq would silently gather clamped
+    learned position embeddings; generate_seq2seq must raise instead."""
+    import pytest
+    from rocket_tpu.models.generate import generate_seq2seq
+    from rocket_tpu.models.seq2seq import EncoderDecoder, Seq2SeqConfig
+
+    cfg = Seq2SeqConfig.tiny(positions="learned")
+    m = EncoderDecoder(cfg)
+    inputs = jnp.zeros((1, cfg.max_seq + 1), jnp.int32)
+    batch = {"inputs": jnp.zeros((1, 4), jnp.int32),
+             "targets": jnp.zeros((1, 4), jnp.int32)}
+    vs = nn.meta.unbox(m.init(jax.random.PRNGKey(0), batch))
+    with pytest.raises(ValueError, match="encoder inputs"):
+        generate_seq2seq(m, vs, inputs, max_new_tokens=2, bos_id=1)
