@@ -254,12 +254,18 @@ class DataLoader:
             return
         # partial final batch: pad to L with copies of a real sample,
         # masked invalid (static shapes, SURVEY §7.4)
-        if template is None:
+        if remaining < procs:
+            # Every process iterates the SAME stream and computes the same
+            # `remaining`, so this raise fires on ALL hosts — a per-host
+            # template check would crash only the starved process while its
+            # peers enter the global-batch collective and deadlock.
             raise ValueError(
-                f"process {p}/{procs} saw no stream samples at all; a "
-                f"streaming source must yield at least one sample per "
-                f"process to form a padded batch"
+                f"stream yielded only {remaining} sample(s) past the resume "
+                f"point for {procs} processes; every process needs at least "
+                f"one sample to form the padded final batch (raised on all "
+                f"hosts to avoid a crash-vs-collective deadlock)"
             )
+        assert template is not None  # remaining >= procs covers every rank
         valid = np.zeros(L, dtype=bool)
         valid[: len(rows)] = True
         rows = rows + [template] * (L - len(rows))

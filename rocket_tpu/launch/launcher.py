@@ -167,7 +167,16 @@ class Launcher(Dispatcher):
         available = set(io.keys(path))
         if not self._resume_load_capsules:
             # Weights-only: leave resume_spec armed for Modules, skip the
-            # host states (reference ``launcher.py:349-359``).
+            # host states (reference ``launcher.py:349-359``) — but the
+            # topology guard applies to BOTH resume paths (reference
+            # ``launcher.py:370-375``): arrays saved by a different
+            # process count are still an elastic resume.  Peek at the
+            # saved launcher state without adopting its epoch counter.
+            if self._ckpt_key is not None and self._ckpt_key in available:
+                saved = Attributes(io.restore_item(path, self._ckpt_key))
+                self._check_resume_topology(
+                    saved.get("num_procs"), ", weights-only included"
+                )
             self._logger.info("weights-only resume from %s", path)
             return
         for capsule in self._runtime.checkpointables:
@@ -182,20 +191,26 @@ class Launcher(Dispatcher):
                 )
             state = io.restore_item(path, key)
             capsule.load_state_dict(Attributes(state))
-        # Topology guard (reference ``launcher.py:370-375``).
-        if (
-            self._saved_num_procs is not None
-            and self._saved_num_procs != self._runtime.process_count
-        ):
-            raise RuntimeError(
-                f"resume topology mismatch: checkpoint was written by "
-                f"{self._saved_num_procs} processes, this run has "
-                f"{self._runtime.process_count}. Elastic resume is not "
-                f"supported (reference launcher.py:370-375)."
-            )
+        self._check_resume_topology(self._saved_num_procs)
         self._logger.info(
             "resumed from %s at epoch %d", path, self._epoch_idx
         )
+
+    def _check_resume_topology(
+        self, saved_procs: Optional[int], qualifier: str = ""
+    ) -> None:
+        """Topology guard, shared by both resume paths (reference
+        ``launcher.py:370-375``)."""
+        if (
+            saved_procs is not None
+            and int(saved_procs) != self._runtime.process_count
+        ):
+            raise RuntimeError(
+                f"resume topology mismatch: checkpoint was written by "
+                f"{int(saved_procs)} processes, this run has "
+                f"{self._runtime.process_count}. Elastic resume is not "
+                f"supported{qualifier} (reference launcher.py:370-375)."
+            )
 
     # -- the run -------------------------------------------------------------
 

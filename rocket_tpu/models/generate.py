@@ -146,12 +146,17 @@ def generate_seq2seq(
             f"1 + max_new_tokens = {total} exceeds max_seq "
             f"{model.config.max_seq}"
         )
-    if inputs.shape[1] > model.config.max_seq:
-        # With learned positions an over-length encoder input would
-        # silently gather clamped position embeddings instead of erroring.
+    if (
+        model.config.positions == "learned"
+        and inputs.shape[1] > model.config.max_seq
+    ):
+        # Learned positions only have max_seq table rows: the encoder
+        # would die in a confusing (1, max_seq, H)-vs-(B, S, H) broadcast
+        # error — fail with the actual cause instead.  RoPE computes
+        # positions on the fly and handles longer inputs (extrapolated).
         raise ValueError(
             f"encoder inputs length {inputs.shape[1]} exceeds max_seq "
-            f"{model.config.max_seq}"
+            f"{model.config.max_seq} (learned position table size)"
         )
     if rng is None:
         rng = jax.random.PRNGKey(0)

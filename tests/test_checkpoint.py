@@ -501,3 +501,27 @@ def test_weights_only_resume_reseeds_ema(tmp_path, devices):
         # decay 0.5 over >=4 steps: EMA within a small neighborhood of the
         # live params; a random-init seed would differ at O(1).
         assert float(jnp.abs(e - p).max()) < 0.05
+
+
+def test_weights_only_topology_guard(tmp_path, devices):
+    """The topology guard applies to BOTH resume paths (reference
+    launcher.py:370-375): a weights-only restore of arrays saved by a
+    different process count is still an elastic resume.  Single-process
+    env: pretend the current run has 2 processes."""
+    from rocket_tpu.runtime import Runtime
+
+    data = synthetic_classification(n=128)
+    launcher, _ = _tree(tmp_path, data, epochs=1, save_every=2)
+    launcher.launch()
+    ckpt = str(tmp_path / "ckpt" / "v0" / "weights" / "000001")
+
+    launcher2, _ = _tree(
+        tmp_path, data, epochs=1, resume=ckpt, load_capsules=False
+    )
+    orig = Runtime.process_count
+    Runtime.process_count = property(lambda self: 2)
+    try:
+        with pytest.raises(RuntimeError, match="weights-only included"):
+            launcher2.launch()
+    finally:
+        Runtime.process_count = orig
