@@ -22,7 +22,7 @@ honor_cpu_request()
 
 import rocket_tpu as rt
 from rocket_tpu.data.toys import synthetic_lm_tokens
-from rocket_tpu.models.lora import freeze_non_lora
+from rocket_tpu.models.lora import is_lora
 from rocket_tpu.models.objectives import lm_cross_entropy
 from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
 from rocket_tpu.parallel.mesh import MeshSpec
@@ -65,7 +65,7 @@ def main():
         capsules=[
             rt.Loss(lm_cross_entropy(), name="lm"),
             # Base weights frozen; only LoRA adapters train.
-            rt.Optimizer(learning_rate=1e-4, wrap=freeze_non_lora),
+            rt.Optimizer(learning_rate=1e-4, params_filter=is_lora),
         ],
     )
     launcher = rt.Launcher(

@@ -34,6 +34,7 @@ Blackboard protocol:
 
 from __future__ import annotations
 
+import math
 from typing import Any, Iterable, Optional, Sequence
 
 import jax
@@ -157,30 +158,112 @@ class Module(Dispatcher):
         ]
         optimizers = [c for c in self._capsules if isinstance(c, Optimizer)]
         schedulers = [c for c in self._capsules if isinstance(c, Scheduler)]
-        if len(optimizers) > 1 or len(schedulers) > 1:
+        if len(schedulers) > 1:
             raise RuntimeError(
-                "a Module hosts at most one Optimizer and one Scheduler"
+                "a Module hosts at most one Scheduler (it is the default "
+                "schedule; per-group schedules go on each Optimizer)"
             )
         self._schedule = schedulers[0].schedule if schedulers else None
-        if self._eval_with_ema and (
-            not optimizers or not optimizers[0].has_ema
-        ):
+        self._group_label_fn = None
+        if self._eval_with_ema and not any(o.has_ema for o in optimizers):
             # Fail at setup, not at the first eval launch hours into a run.
             raise RuntimeError(
                 "Module(eval_with_ema=True) requires an Optimizer with "
                 "ema_decay set"
             )
-        if optimizers:
-            self._tx = optimizers[0].build_tx(self._schedule)
-            optimizers[0].attach_schedule(
-                self._schedule
-                if self._schedule is not None
-                else optimizers[0].constant_schedule()
-            )
+        if len(optimizers) == 1 and optimizers[0].params_filter is None:
+            opt = optimizers[0]
+            effective = opt.own_schedule or self._schedule
+            self._tx = opt.build_tx(effective)
+            opt.attach_schedule(self._log_schedule_for(opt, effective))
+        elif optimizers:
+            # One optimizer WITH a params_filter also routes here: its
+            # group trains, everything unmatched is frozen.
+            self._tx = self._build_multi_tx(optimizers)
         if self._tx is not None and not self._objectives:
             raise RuntimeError(
                 "Module has an Optimizer but no Loss — nothing to minimize"
             )
+
+    def _build_multi_tx(self, optimizers: Sequence[Any]):
+        """Compose N Optimizer capsules into one transform — the reference's
+        per-optimizer torch param groups (``rocket/core/module.py:50-60``),
+        done the optax way: ``multi_transform`` over path-labelled groups,
+        params matched by no group frozen (``set_to_zero``)."""
+        import optax
+
+        tags = [o.tag for o in optimizers]
+        if len(set(tags)) != len(tags):
+            raise RuntimeError(
+                f"multiple Optimizer capsules need distinct tag= for LR "
+                f"logging, got {tags}"
+            )
+        if "frozen" in tags:
+            # 'frozen' labels the unmatched-params bucket; a group with
+            # that tag would merge into it in the accounting and dodge the
+            # empty-group check.
+            raise RuntimeError(
+                "Optimizer tag='frozen' is reserved for the "
+                "unmatched-params bucket — pick another tag"
+            )
+        for opt in optimizers:
+            if len(optimizers) > 1 and opt.params_filter is None:
+                raise RuntimeError(
+                    "with multiple Optimizer capsules every one needs "
+                    "params_filter=(path, leaf) -> bool to define its "
+                    "param group"
+                )
+            if opt.has_ema:
+                # Under multi_transform's masking the EMA would cover only
+                # the group's leaves — Module.ema_params / eval_with_ema
+                # would silently evaluate a partial tree.
+                raise RuntimeError(
+                    "ema_decay is not supported together with "
+                    "params_filter param groups (the EMA would cover one "
+                    "group only); for LoRA-style freezing with EMA use "
+                    "wrap= (e.g. wrap=freeze_non_lora) instead"
+                )
+
+        filters = [o.params_filter for o in optimizers]
+
+        def label(path, leaf):
+            matches = [i for i, f in enumerate(filters) if f(path, leaf)]
+            if len(matches) > 1:
+                raise ValueError(
+                    f"param {jax.tree_util.keystr(path)} matched by "
+                    f"multiple Optimizers (tags "
+                    f"{[tags[i] for i in matches]}); param groups must be "
+                    f"disjoint"
+                )
+            return f"g{matches[0]}" if matches else "frozen"
+
+        def label_fn(params):
+            return jax.tree_util.tree_map_with_path(label, params)
+
+        self._group_label_fn = label_fn
+        transforms = {"frozen": optax.set_to_zero()}
+        for i, opt in enumerate(optimizers):
+            # A ready tx= owns its learning rate — the sibling Scheduler
+            # default applies only to optimizers it CAN configure.
+            if opt.has_ready_tx:
+                effective = None
+            else:
+                effective = opt.own_schedule or self._schedule
+            transforms[f"g{i}"] = opt.build_tx(effective)
+            opt.attach_schedule(self._log_schedule_for(opt, effective))
+        self._group_tags = tags
+        return optax.multi_transform(transforms, label_fn)
+
+    @staticmethod
+    def _log_schedule_for(opt: Any, effective: Optional[Any]) -> Any:
+        """What the Optimizer capsule should LOG as its LR: the effective
+        schedule; a ready ``tx=`` owns its LR opaquely, so log nothing
+        rather than a fabricated constant."""
+        if effective is not None:
+            return effective
+        if opt.has_ready_tx:
+            return None
+        return opt.constant_schedule()
 
     # -- state materialization ---------------------------------------------
 
@@ -222,6 +305,36 @@ class Module(Dispatcher):
             )
 
         abstract_state = jax.eval_shape(init_fn)
+        if getattr(self, "_group_label_fn", None) is not None:
+            # Param-group visibility: silent group membership is the
+            # multi-optimizer footgun (a filter matching nothing trains
+            # nothing) — log leaf/param counts per group up front.
+            labels = self._group_label_fn(abstract_state.params)
+            counts: dict = {}
+            for lbl, leaf in zip(
+                jax.tree_util.tree_leaves(labels),
+                jax.tree_util.tree_leaves(abstract_state.params),
+            ):
+                name = (
+                    self._group_tags[int(lbl[1:])]
+                    if lbl.startswith("g") else lbl
+                )
+                n_leaves, n_params = counts.get(name, (0, 0))
+                counts[name] = (
+                    n_leaves + 1,
+                    n_params + int(math.prod(leaf.shape)),
+                )
+            self._logger.info(
+                "optimizer param groups: %s",
+                {k: f"{v[1]:,} params / {v[0]} leaves"
+                 for k, v in counts.items()},
+            )
+            for i, tag in enumerate(self._group_tags):
+                if tag not in counts:
+                    raise RuntimeError(
+                        f"Optimizer tag={tag!r}: params_filter matched no "
+                        f"parameters — group would train nothing"
+                    )
         param_specs = self._adapter.partition_specs(
             abstract_state.params, runtime.rules
         )

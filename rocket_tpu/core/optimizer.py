@@ -51,6 +51,16 @@ class Optimizer(Capsule):
         optimizer state carries an EMA of the parameters (sharded,
         donated, and checkpointed with the train state); read it via
         ``Module.ema_params``.
+    params_filter:
+        ``(path, leaf) -> bool`` selecting this optimizer's parameter
+        group (the reference's per-optimizer torch param groups,
+        ``rocket/core/module.py:50-60``).  Required when a Module hosts
+        more than one Optimizer; the parent composes the groups with
+        ``optax.multi_transform`` and freezes params matched by none.
+    schedule:
+        Optional per-optimizer LR schedule (``step -> lr``).  Takes
+        precedence over a sibling ``Scheduler`` capsule, which acts as
+        the default for optimizers without their own schedule.
     """
 
     def __init__(
@@ -61,6 +71,8 @@ class Optimizer(Capsule):
         grad_clip_norm: Optional[float] = None,
         wrap: Optional[Callable[[optax.GradientTransformation], optax.GradientTransformation]] = None,
         ema_decay: Optional[float] = None,
+        params_filter: Optional[Callable[[tuple, Any], bool]] = None,
+        schedule: Optional[Callable[[int], Any]] = None,
         tag: str = "lr",
         statefull: bool = True,
         priority: int = 1000,
@@ -68,12 +80,19 @@ class Optimizer(Capsule):
         **tx_kwargs: Any,
     ) -> None:
         super().__init__(statefull=statefull, priority=priority, logger=logger)
+        if tx is not None and schedule is not None:
+            raise ValueError(
+                "Optimizer(tx=..., schedule=...): a ready optax transform "
+                "already owns its learning rate; pass tx_factory instead"
+            )
         self._tx = tx
         self._tx_factory = tx_factory
         self._learning_rate = learning_rate
         self._grad_clip_norm = grad_clip_norm
         self._wrap = wrap
         self._ema_decay = ema_decay
+        self._params_filter = params_filter
+        self._own_schedule = schedule
         self._tx_kwargs = tx_kwargs
         self._tag = tag
         self._iter_idx = 0
@@ -87,6 +106,24 @@ class Optimizer(Capsule):
         (``ema_decay`` was set) — the contract ``Module(eval_with_ema=
         True)`` checks at setup."""
         return self._ema_decay is not None
+
+    @property
+    def has_ready_tx(self) -> bool:
+        """True when constructed with a ready ``tx=`` — it owns its LR, so
+        a sibling Scheduler default does not apply to it."""
+        return self._tx is not None
+
+    @property
+    def params_filter(self) -> Optional[Callable[[tuple, Any], bool]]:
+        return self._params_filter
+
+    @property
+    def own_schedule(self) -> Optional[Callable[[int], Any]]:
+        return self._own_schedule
+
+    @property
+    def tag(self) -> str:
+        return self._tag
 
     def build_tx(
         self, schedule: Optional[optax.Schedule] = None

@@ -1,7 +1,7 @@
 from rocket_tpu.models import objectives
 from rocket_tpu.models.layers import Embed, PDense, RMSNorm, apply_rope, rotary_embedding
 from rocket_tpu.models.lenet import LeNet
-from rocket_tpu.models.lora import freeze_non_lora, freeze_where, lora_labels, merge_lora
+from rocket_tpu.models.lora import freeze_non_lora, freeze_where, is_lora, lora_labels, merge_lora
 from rocket_tpu.models.resnet import ResNet, resnet18, resnet50
 from rocket_tpu.models.seq2seq import EncoderDecoder, Seq2SeqConfig
 from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
@@ -21,6 +21,7 @@ __all__ = [
     "ViTConfig",
     "apply_rope",
     "freeze_non_lora",
+    "is_lora",
     "freeze_where",
     "lora_labels",
     "merge_lora",

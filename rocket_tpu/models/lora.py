@@ -31,6 +31,13 @@ def _is_lora_path(path) -> bool:
     return False
 
 
+def is_lora(path, leaf: Any = None) -> bool:
+    """``(path, leaf) -> bool`` param filter selecting adapter leaves —
+    the ``Optimizer(params_filter=is_lora)`` spelling of the LoRA
+    fine-tune (unmatched base weights freeze automatically)."""
+    return _is_lora_path(path)
+
+
 def lora_labels(params: Any) -> Any:
     """'train' on adapter leaves, 'freeze' elsewhere."""
     return jax.tree_util.tree_map_with_path(

@@ -1,0 +1,288 @@
+"""Multi-optimizer / param-group composition (VERDICT r3 missing #1).
+
+The reference Module hosts N Optimizer capsules, each stepping its own
+torch param group (``rocket/core/module.py:50-60``).  Here N Optimizer
+capsules compose into ONE jitted step via ``optax.multi_transform`` over
+path-labelled groups; params matched by no group freeze.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import rocket_tpu as rt
+from rocket_tpu.models.objectives import cross_entropy
+
+
+class TwoPart(nn.Module):
+    """backbone -> head, with path-addressable param groups."""
+
+    @nn.compact
+    def __call__(self, batch, train: bool = False):
+        x = nn.relu(nn.Dense(16, name="backbone")(batch["x"]))
+        logits = nn.Dense(4, name="head")(x)
+        out = rt.Attributes(batch)
+        out["logits"] = logits
+        return out
+
+
+def _path_has(name):
+    def f(path, leaf):
+        return any(
+            str(getattr(p, "key", getattr(p, "name", ""))) == name
+            for p in path
+        )
+
+    return f
+
+
+def _batch(seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": jnp.asarray(rng.normal(size=(8, 8)), jnp.float32),
+        "label": jnp.asarray(rng.integers(0, 4, size=(8,)), jnp.int32),
+    }
+
+
+def _module(optimizers, **kw):
+    mod = rt.Module(
+        TwoPart(),
+        capsules=[rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                  *optimizers],
+        **kw,
+    )
+    mod.bind(rt.Runtime())
+    mod.setup()
+    return mod
+
+
+def _run(mod, n=3):
+    attrs = rt.Attributes(
+        looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
+    )
+    for _ in range(n):
+        attrs.batch = _batch()
+        mod.launch(attrs)
+    return attrs
+
+
+def _flat(params):
+    return {
+        jax.tree_util.keystr(p): np.asarray(v)
+        for p, v in jax.tree_util.tree_leaves_with_path(params)
+    }
+
+
+def test_zero_lr_backbone_trains_only_head(devices):
+    """The VERDICT contract: backbone LR 0 + head LR>0 trains only the
+    head — two Optimizer capsules, one step."""
+    mod = _module([
+        rt.Optimizer(learning_rate=0.0, params_filter=_path_has("backbone"),
+                     tag="lr_backbone"),
+        rt.Optimizer(learning_rate=0.1, params_filter=_path_has("head"),
+                     tag="lr_head"),
+    ])
+    mod.materialize(_batch())
+    before = _flat(mod.state.params)
+    _run(mod)
+    after = _flat(mod.state.params)
+    for key in before:
+        if "backbone" in key:
+            np.testing.assert_array_equal(before[key], after[key])
+        else:
+            assert not np.allclose(before[key], after[key]), key
+    mod.destroy()
+
+
+def test_both_groups_train_with_distinct_lrs(devices):
+    mod = _module([
+        rt.Optimizer(learning_rate=0.05, params_filter=_path_has("backbone"),
+                     tag="lr_backbone"),
+        rt.Optimizer(learning_rate=0.1, params_filter=_path_has("head"),
+                     tag="lr_head"),
+    ])
+    mod.materialize(_batch())
+    before = _flat(mod.state.params)
+    attrs = _run(mod)
+    after = _flat(mod.state.params)
+    for key in before:
+        assert not np.allclose(before[key], after[key]), key
+    # per-group LR logging landed in the looper state under distinct tags
+    assert float(attrs.looper.state["lr_backbone"]) == 0.05
+    assert float(attrs.looper.state["lr_head"]) == 0.1
+    mod.destroy()
+
+
+def test_single_filter_freezes_unmatched(devices):
+    """One Optimizer with params_filter: its group trains, the rest
+    freezes — the one-capsule spelling of a head-only fine-tune."""
+    mod = _module([
+        rt.Optimizer(learning_rate=0.1, params_filter=_path_has("head")),
+    ])
+    mod.materialize(_batch())
+    before = _flat(mod.state.params)
+    _run(mod)
+    after = _flat(mod.state.params)
+    for key in before:
+        if "backbone" in key:
+            np.testing.assert_array_equal(before[key], after[key])
+        else:
+            assert not np.allclose(before[key], after[key]), key
+    mod.destroy()
+
+
+def test_per_optimizer_schedule_overrides_sibling_scheduler(devices):
+    """Sibling Scheduler = default schedule; Optimizer(schedule=...) wins
+    for its own group."""
+    own = optax.constant_schedule(0.07)
+    mod = _module([
+        rt.Optimizer(params_filter=_path_has("backbone"),
+                     tag="lr_backbone"),
+        rt.Optimizer(params_filter=_path_has("head"), schedule=own,
+                     tag="lr_head"),
+        rt.Scheduler(optax.constant_schedule(0.02)),
+    ])
+    mod.materialize(_batch())
+    attrs = _run(mod, n=1)
+    assert float(attrs.looper.state["lr_backbone"]) == pytest.approx(0.02)
+    assert float(attrs.looper.state["lr_head"]) == pytest.approx(0.07)
+    mod.destroy()
+
+
+def test_missing_filter_rejected(devices):
+    with pytest.raises(RuntimeError, match="params_filter"):
+        _module([
+            rt.Optimizer(learning_rate=0.1, params_filter=_path_has("head"),
+                         tag="a"),
+            rt.Optimizer(learning_rate=0.1, tag="b"),
+        ])
+
+
+def test_duplicate_tags_rejected(devices):
+    with pytest.raises(RuntimeError, match="distinct tag"):
+        _module([
+            rt.Optimizer(learning_rate=0.1, params_filter=_path_has("head")),
+            rt.Optimizer(learning_rate=0.1,
+                         params_filter=_path_has("backbone")),
+        ])
+
+
+def test_overlapping_groups_rejected(devices):
+    mod = _module([
+        rt.Optimizer(learning_rate=0.1, params_filter=_path_has("head"),
+                     tag="a"),
+        rt.Optimizer(learning_rate=0.1, params_filter=lambda p, x: True,
+                     tag="b"),
+    ])
+    with pytest.raises(ValueError, match="multiple Optimizers"):
+        mod.materialize(_batch())
+    mod.destroy()
+
+
+def test_empty_group_rejected(devices):
+    mod = _module([
+        rt.Optimizer(learning_rate=0.1, params_filter=_path_has("head"),
+                     tag="a"),
+        rt.Optimizer(learning_rate=0.1, params_filter=_path_has("no_such"),
+                     tag="b"),
+    ])
+    with pytest.raises(RuntimeError, match="matched no"):
+        mod.materialize(_batch())
+    mod.destroy()
+
+
+def test_ema_with_multiple_optimizers_rejected(devices):
+    with pytest.raises(RuntimeError, match="ema_decay"):
+        _module([
+            rt.Optimizer(learning_rate=0.1, params_filter=_path_has("head"),
+                         tag="a", ema_decay=0.99),
+            rt.Optimizer(learning_rate=0.1,
+                         params_filter=_path_has("backbone"), tag="b"),
+        ])
+
+
+def test_lora_params_filter_matches_wrap_freeze(devices):
+    """Optimizer(params_filter=is_lora) must train identically to the
+    wrap=freeze_non_lora spelling (same seed, same steps)."""
+    from rocket_tpu.models.lora import freeze_non_lora, is_lora
+    from rocket_tpu.models.objectives import lm_cross_entropy
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    def lm_batch():
+        rng = np.random.default_rng(0)
+        return {"tokens": jnp.asarray(
+            rng.integers(0, 256, size=(4, 32)), jnp.int32)}
+
+    results = []
+    for opt in (
+        rt.Optimizer(learning_rate=1e-2, wrap=freeze_non_lora),
+        rt.Optimizer(learning_rate=1e-2, params_filter=is_lora),
+    ):
+        cfg = TransformerConfig.tiny(lora_rank=4)
+        mod = rt.Module(
+            TransformerLM(cfg),
+            capsules=[rt.Loss(lm_cross_entropy(), name="lm"), opt],
+        )
+        mod.bind(rt.Runtime())
+        mod.setup()
+        mod.materialize(lm_batch())
+        attrs = rt.Attributes(
+            looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
+        )
+        for _ in range(3):
+            attrs.batch = lm_batch()
+            mod.launch(attrs)
+        results.append(_flat(mod.state.params))
+        mod.destroy()
+    assert results[0].keys() == results[1].keys()
+    for key in results[0]:
+        np.testing.assert_allclose(
+            results[0][key], results[1][key], atol=1e-6, rtol=1e-5,
+            err_msg=key,
+        )
+
+
+def test_ready_tx_group_skips_scheduler_default(devices):
+    """A ready tx= owns its LR: the sibling Scheduler default must not be
+    force-injected into (and break) that group, and no fabricated LR is
+    logged for it."""
+    mod = _module([
+        rt.Optimizer(tx=optax.sgd(0.1), params_filter=_path_has("head"),
+                     tag="lr_head"),
+        rt.Optimizer(params_filter=_path_has("backbone"),
+                     tag="lr_backbone"),
+        rt.Scheduler(optax.constant_schedule(0.02)),
+    ])
+    mod.materialize(_batch())
+    before = _flat(mod.state.params)
+    attrs = _run(mod, n=2)
+    after = _flat(mod.state.params)
+    for key in before:  # both groups actually train
+        assert not np.allclose(before[key], after[key]), key
+    assert float(attrs.looper.state["lr_backbone"]) == pytest.approx(0.02)
+    assert "lr_head" not in attrs.looper.state  # opaque tx: no LR log
+    mod.destroy()
+
+
+def test_single_filter_with_ema_rejected_clearly(devices):
+    """One filtered Optimizer + ema_decay: the masked EMA would cover the
+    group only — the error must describe THIS situation, not 'multiple
+    Optimizer capsules'."""
+    with pytest.raises(RuntimeError, match="params_filter"):
+        _module([
+            rt.Optimizer(learning_rate=0.1,
+                         params_filter=_path_has("head"), ema_decay=0.99),
+        ])
+
+
+def test_frozen_tag_reserved(devices):
+    with pytest.raises(RuntimeError, match="reserved"):
+        _module([
+            rt.Optimizer(learning_rate=0.1, params_filter=_path_has("head"),
+                         tag="frozen"),
+            rt.Optimizer(learning_rate=0.1,
+                         params_filter=_path_has("backbone"), tag="b"),
+        ])
