@@ -67,9 +67,20 @@ class Loss(Capsule):
         # Accumulate the window mean lazily on device (reference accumulates
         # ``_value += loss / accumulation_steps`` per micro-batch,
         # ``loss.py:97-98`` — but blocks on a gather to do it; here the adds
-        # stay async and nothing syncs until tracker flush).
-        accum = self._runtime.gradient_accumulation_steps if self._runtime else 1
-        self._window = self._window + value / accum if accum > 1 else value
+        # stay async and nothing syncs until tracker flush).  A fused
+        # window step (Module(fuse_accumulation=True)) delivers ONE
+        # already-window-averaged value — dividing again would
+        # under-report by the accumulation factor.
+        if logs.get("window_averaged"):
+            self._window = value
+        else:
+            accum = (
+                self._runtime.gradient_accumulation_steps
+                if self._runtime else 1
+            )
+            self._window = (
+                self._window + value / accum if accum > 1 else value
+            )
         if not logs.synced:
             return
         value = self._window

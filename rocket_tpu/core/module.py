@@ -46,7 +46,11 @@ from rocket_tpu.core.dispatcher import Dispatcher
 from rocket_tpu.engine.adapter import FlaxModel, ModelAdapter, state_shardings
 from rocket_tpu.engine.state import TrainState, param_count
 from rocket_tpu.engine.ema import reseed_ema
-from rocket_tpu.engine.step import build_eval_step, build_train_step
+from rocket_tpu.engine.step import (
+    build_eval_step,
+    build_train_step,
+    build_window_step,
+)
 from rocket_tpu.parallel.sharding import tree_shardings
 
 
@@ -82,6 +86,18 @@ class Module(Dispatcher):
         Optional abstract batch (pytree of ``jax.ShapeDtypeStruct``) for
         eager state materialization at setup; default is lazy
         materialization on the first batch.
+    fuse_accumulation:
+        With ``gradient_accumulation_steps > 1``: buffer the window's
+        batches on host and run ONE jitted step over all of them
+        (objectives averaged per window slice — numerically the micro/sync
+        semantics).  Built for pipelined models (the GPipe fill/drain
+        bubble is paid once per effective step, and
+        ``pipeline_microbatch_size`` keeps microbatch size constant as the
+        window widens); memory scales with the window's activations, so
+        leave off for non-pipelined models.  A mid-window resume restarts
+        the window (no ``grad_accum`` buffer exists to checkpoint) —
+        align ``Checkpointer(save_every=...)`` to the accumulation
+        boundary.
     """
 
     # Array state restores at materialization (sharded, direct to mesh) —
@@ -97,6 +113,7 @@ class Module(Dispatcher):
         priority: int = 1000,
         donate: bool = True,
         eval_with_ema: bool = False,
+        fuse_accumulation: bool = False,
         logger: Optional[Any] = None,
     ) -> None:
         super().__init__(
@@ -106,6 +123,7 @@ class Module(Dispatcher):
         self._input_spec = input_spec
         self._donate = donate
         self._eval_with_ema = eval_with_ema
+        self._fuse_accum = fuse_accumulation
         self._built = False
         self._state: Optional[TrainState] = None
         self._steps: Optional[dict] = None
@@ -114,6 +132,7 @@ class Module(Dispatcher):
         self._schedule = None
         self._micro_idx = 0
         self._accum = 1
+        self._window_buffer: list = []
         self._pending_restore: Optional[Any] = None
 
     # -- setup / teardown ---------------------------------------------------
@@ -145,6 +164,7 @@ class Module(Dispatcher):
         # reference's torch module keeps its weights after launch.
         self._steps = None
         self._eval_step = None
+        self._window_buffer = []
         self._built = False
         super().destroy(attrs)
 
@@ -295,7 +315,11 @@ class Module(Dispatcher):
                 tx,
                 rng=rng,
                 mutable=mutable,
-                gradient_accumulation_steps=self._accum,
+                # Fused windows hold the whole window's batches instead of
+                # a grad_accum buffer — the state needs none.
+                gradient_accumulation_steps=(
+                    1 if self._use_window else self._accum
+                ),
             )
 
         def abstract_batch_concrete() -> Any:
@@ -305,6 +329,17 @@ class Module(Dispatcher):
             )
 
         abstract_state = jax.eval_shape(init_fn)
+        if self._use_window and jax.tree_util.tree_leaves(
+            abstract_state.mutable
+        ):
+            # One fused forward updates mutable collections (batch stats)
+            # once per window, not once per micro-batch — silently
+            # different statistics vs the micro/sync path.
+            raise RuntimeError(
+                "fuse_accumulation=True does not support models with "
+                "mutable collections (batch stats); use the default "
+                "micro/sync accumulation"
+            )
         if getattr(self, "_group_label_fn", None) is not None:
             # Param-group visibility: silent group membership is the
             # multi-optimizer footgun (a filter matching nothing trains
@@ -368,16 +403,32 @@ class Module(Dispatcher):
         self._shardings = shardings
         self._build_steps(policy)
 
+    @property
+    def _use_window(self) -> bool:
+        return self._fuse_accum and self._accum > 1
+
     def _build_steps(self, policy) -> None:
         if self._tx is not None:
-            self._steps = build_train_step(
-                self._adapter.apply_fn,
-                self._objectives,
-                self._tx,
-                policy=policy,
-                gradient_accumulation_steps=self._accum,
-                donate=self._donate,
-            )
+            if self._use_window:
+                self._steps = {
+                    "window": build_window_step(
+                        self._adapter.apply_fn,
+                        self._objectives,
+                        self._tx,
+                        policy=policy,
+                        window=self._accum,
+                        donate=self._donate,
+                    )
+                }
+            else:
+                self._steps = build_train_step(
+                    self._adapter.apply_fn,
+                    self._objectives,
+                    self._tx,
+                    policy=policy,
+                    gradient_accumulation_steps=self._accum,
+                    donate=self._donate,
+                )
         self._eval_step = build_eval_step(
             self._adapter.apply_fn, self._objectives, policy=policy,
             use_ema=self._eval_with_ema,
@@ -446,13 +497,33 @@ class Module(Dispatcher):
         grad_enabled = True if looper is None else bool(looper.grad_enabled)
 
         if grad_enabled and self._steps is not None:
-            synced = (self._micro_idx + 1) % self._accum == 0
-            step = self._steps["sync" if synced else "micro"]
-            self._state, logs = step(self._state, batch)
-            self._micro_idx = 0 if synced else self._micro_idx + 1
-            logs = Attributes(logs)
-            logs.synced = synced
-            attrs.step_logs = logs
+            if "window" in self._steps:
+                # Fused accumulation: buffer the window, run ONE jitted
+                # call on the boundary — a pipelined model pays its
+                # fill/drain bubble once per effective step.
+                self._window_buffer.append(batch)
+                if len(self._window_buffer) < self._accum:
+                    attrs.step_logs = None  # mid-window: nothing ran
+                    for capsule in self._capsules:
+                        capsule.launch(attrs)
+                    return
+                batches = tuple(self._window_buffer)
+                self._window_buffer = []
+                self._state, logs = self._steps["window"](
+                    self._state, batches
+                )
+                logs = Attributes(logs)
+                logs.synced = True
+                logs.window_averaged = True  # Loss must not divide again
+                attrs.step_logs = logs
+            else:
+                synced = (self._micro_idx + 1) % self._accum == 0
+                step = self._steps["sync" if synced else "micro"]
+                self._state, logs = step(self._state, batch)
+                self._micro_idx = 0 if synced else self._micro_idx + 1
+                logs = Attributes(logs)
+                logs.synced = synced
+                attrs.step_logs = logs
         else:
             batch_out, logs = self._eval_step(self._state, batch)
             attrs.batch = batch_out
@@ -508,6 +579,10 @@ class Module(Dispatcher):
         restored TrainState so a resume that lands mid-window re-enters the
         window where it left off (``state.micro`` is the saved counterpart
         of ``_micro_idx``: +1 per micro step, reset to 0 at each sync)."""
+        # Fused mode: the docstring contract is "a mid-window resume
+        # restarts the window" — drop any pre-restore buffered batches or
+        # the next boundary would train the restored params on stale data.
+        self._window_buffer = []
         if self._state is not None and self._state.micro is not None:
             self._micro_idx = int(self._state.micro) % self._accum
         else:

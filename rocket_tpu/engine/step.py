@@ -182,6 +182,103 @@ def build_train_step(
     return steps
 
 
+def build_window_step(
+    apply_fn: ApplyFn,
+    objectives: Sequence[Objective],
+    tx: optax.GradientTransformation,
+    policy: Policy = Policy(),
+    window: int = 1,
+    log_grad_norm: bool = True,
+    donate: bool = True,
+) -> Callable[[TrainState, Tuple[Any, ...]], Tuple[TrainState, Dict[str, Any]]]:
+    """Fused gradient-accumulation step: ONE jitted call consumes the whole
+    ``window``-batch accumulation window, concatenated on the batch dim,
+    with one forward/backward.
+
+    Built for pipelined models (``pipeline_microbatch_size``): the
+    concatenated window flows through a single GPipe pass, so the
+    ``2(P-1)``-tick fill/drain bubble is paid once per EFFECTIVE step
+    instead of once per micro-batch (VERDICT r3 next #5).  Also skips the
+    ``grad_accum`` buffer entirely — the window's activations replace it.
+
+    Objective semantics match the micro/sync pair: each objective is
+    evaluated per window slice and averaged with equal weight (NOT one
+    mean over the concatenated batch — a per-token mean would weight
+    slices by their valid-token counts when masks vary).  Two documented
+    divergences from micro/sync: (a) the rng folds once per EFFECTIVE
+    step, not once per micro-batch — deterministic (dropout-free) models
+    only, which pipelining already requires; (b) mutable collections
+    would update once per window — Module rejects them at materialize.
+
+    Slicing contract: ``batch_out`` leaves whose leading dim equals the
+    concatenated window row count are treated as batch-major per-example
+    outputs (the blackboard batch-rewriting contract); other leaves pass
+    through to every slice's objective unsliced.
+    """
+    if window < 1:
+        raise ValueError("window must be >= 1")
+
+    def window_loss(params, mutable, rng, batches: Tuple[Any, ...]):
+        concat = jax.tree_util.tree_map(
+            lambda *xs: jnp.concatenate(xs, axis=0), *batches
+        )
+        compute_params = policy.cast_to_compute(params)
+        batch_out, new_mutable = apply_fn(
+            compute_params, mutable, rng, concat, True
+        )
+        sizes = [
+            jax.tree_util.tree_leaves(b)[0].shape[0] for b in batches
+        ]
+        offsets = [0]
+        for s in sizes:
+            offsets.append(offsets[-1] + s)
+        total = jnp.zeros((), jnp.float32)
+        logs: Dict[str, Any] = {}
+
+        def slice_out(i):
+            return jax.tree_util.tree_map(
+                lambda x: jax.lax.slice_in_dim(
+                    x, offsets[i], offsets[i + 1], axis=0
+                )
+                if hasattr(x, "ndim") and x.ndim > 0
+                and x.shape[0] == offsets[-1]
+                else x,
+                batch_out,
+            )
+
+        for i in range(len(batches)):
+            part, part_logs = _total_loss(objectives, slice_out(i))
+            total = total + part / len(batches)
+            for k, v in part_logs.items():
+                logs[k] = logs.get(k, 0.0) + jnp.asarray(v, jnp.float32) / len(batches)
+        logs["loss"] = total
+        return total, (logs, new_mutable)
+
+    grad_fn = jax.value_and_grad(window_loss, has_aux=True)
+
+    def window_step(state: TrainState, batches: Tuple[Any, ...]):
+        rng = jax.random.fold_in(state.rng, state.step)
+        (loss, (logs, new_mutable)), grads = grad_fn(
+            state.params, state.mutable, rng, batches
+        )
+        if log_grad_norm:
+            logs["grad_norm"] = optax.global_norm(grads)
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        return (
+            state.replace(
+                step=state.step + 1,
+                params=new_params,
+                opt_state=new_opt_state,
+                mutable=new_mutable,
+            ),
+            logs,
+        )
+
+    donate_argnums = (0,) if donate else ()
+    return jax.jit(window_step, donate_argnums=donate_argnums)
+
+
 def build_eval_step(
     apply_fn: ApplyFn,
     objectives: Sequence[Objective] = (),

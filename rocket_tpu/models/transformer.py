@@ -106,6 +106,38 @@ class TransformerConfig:
     # n_layers by the pipe-axis size; layer params shard over 'pipe' via
     # the 'stage' logical axis.
     pipeline_microbatches: int = 0
+    # Alternative spelling: fixed ROWS per pipeline microbatch, so the
+    # microbatch COUNT scales with the incoming batch — what
+    # Module(fuse_accumulation=True) needs: the fused window widens the
+    # batch k-fold and the pipe runs k x more microbatches of the same
+    # size, amortizing the fill/drain bubble. Mutually exclusive with
+    # pipeline_microbatches.
+    pipeline_microbatch_size: int = 0
+
+    def __post_init__(self) -> None:
+        if self.pipeline_microbatches and self.pipeline_microbatch_size:
+            raise ValueError(
+                "pipeline_microbatches and pipeline_microbatch_size are "
+                "mutually exclusive"
+            )
+
+    @property
+    def pipelined(self) -> bool:
+        return (
+            self.pipeline_microbatches > 0
+            or self.pipeline_microbatch_size > 0
+        )
+
+    def pipeline_n_micro(self, batch: int) -> int:
+        """Microbatch count for an incoming batch of ``batch`` rows."""
+        if self.pipeline_microbatch_size:
+            if batch % self.pipeline_microbatch_size != 0:
+                raise ValueError(
+                    f"batch {batch} not divisible by "
+                    f"pipeline_microbatch_size {self.pipeline_microbatch_size}"
+                )
+            return batch // self.pipeline_microbatch_size
+        return self.pipeline_microbatches
 
     @property
     def kv_heads(self) -> int:
@@ -389,8 +421,8 @@ class PipelinedBlocks(nn.Module):
                 "PipelinedBlocks needs an active mesh context (run through "
                 "Module/Runtime, or wrap in parallel.context.mesh_context)"
             )
-        n_micro = cfg.pipeline_microbatches
         B, S, D = x.shape
+        n_micro = cfg.pipeline_n_micro(B)
         if B % n_micro != 0:
             raise ValueError(
                 f"batch {B} not divisible by {n_micro} microbatches"
@@ -435,14 +467,14 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, batch, train: bool = False, decode: bool = False):
         cfg = self.config
-        if decode and (cfg.scan_layers or cfg.remat
-                       or cfg.pipeline_microbatches > 0):
+        if decode and (cfg.scan_layers or cfg.remat or cfg.pipelined):
             raise ValueError(
                 "decode=True (KV-cache generation) requires the plain "
                 "unrolled layer layout: scan_layers=False, remat=False, "
-                "pipeline_microbatches=0"
+                "no pipelining (pipeline_microbatches=0 and "
+                "pipeline_microbatch_size=0)"
             )
-        if cfg.remat and cfg.pipeline_microbatches > 0:
+        if cfg.remat and cfg.pipelined:
             # PipelinedBlocks does not thread the remat wrap; rejecting the
             # combination beats silently training without rematerialization
             # at a batch size the user sized for remat.
@@ -497,7 +529,7 @@ class TransformerLM(nn.Module):
                 Block, static_argnums=(4,), prevent_cse=False,
                 policy=policies[cfg.remat_policy],
             )
-        if cfg.pipeline_microbatches > 0:
+        if cfg.pipelined:
             x = PipelinedBlocks(cfg, name="pipeline")(
                 x, positions, segment_ids, train
             )

@@ -19,6 +19,12 @@ real, the TPU way:
 
 This is the micro-scale version of the scaling-book recipe: express the
 schedule as collectives, let XLA pick the overlap.
+
+Composing with gradient accumulation: ``Module(fuse_accumulation=True)``
++ ``pipeline_microbatch_size`` feeds the WHOLE accumulation window
+through one gpipe call — ``k x n_micro`` microbatches pay the
+``2(P-1)``-tick fill/drain bubble once per effective step instead of
+once per micro-call (looped-GPipe; see ``engine.step.build_window_step``).
 """
 
 from __future__ import annotations

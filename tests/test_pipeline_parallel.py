@@ -354,3 +354,214 @@ def test_transformer_pipeline_with_fused_knobs(devices):
         losses.append(float(attrs.step_logs["lm"]))
     assert np.isfinite(losses).all() and losses[-1] < losses[0], losses
     mod.destroy()
+
+
+def _fuse_module(runtime, cfg, fuse, lr=1e-2):
+    import rocket_tpu as rt
+    from rocket_tpu.models.objectives import lm_cross_entropy
+    from rocket_tpu.models.transformer import TransformerLM
+
+    mod = rt.Module(
+        TransformerLM(cfg),
+        capsules=[
+            rt.Loss(lm_cross_entropy(), name="lm"),
+            rt.Optimizer(learning_rate=lr),
+        ],
+        fuse_accumulation=fuse,
+    )
+    mod.bind(runtime)
+    mod.setup()
+    return mod
+
+
+def _launch_batches(mod, batches):
+    import rocket_tpu as rt
+
+    attrs = rt.Attributes(
+        looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
+    )
+    logs = []
+    for b in batches:
+        attrs.batch = b
+        mod.launch(attrs)
+        logs.append(attrs.step_logs)
+    return logs
+
+
+def test_fused_window_matches_micro_sync(devices):
+    """Module(fuse_accumulation=True): ONE jitted call over the buffered
+    window must train identically to the micro/sync pair — including
+    per-slice objective averaging when loss masks vary across the window
+    (VERDICT r3 next #5 parity requirement)."""
+    import rocket_tpu as rt
+    from rocket_tpu.models.transformer import TransformerConfig
+
+    rng = np.random.default_rng(7)
+    base = dict(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=32,
+        attention="dot",
+    )
+    # masks differ per batch: slice-equal weighting is observable
+    batches = []
+    for i in range(4):
+        tokens = rng.integers(0, 64, size=(8, 16))
+        mask = np.ones((8, 16), np.float32)  # [B, S]; loss shifts it
+        mask[:, : 3 * (i + 1)] = 0.0
+        batches.append({
+            "tokens": jnp.asarray(tokens, jnp.int32),
+            "loss_mask": jnp.asarray(mask),
+        })
+
+    params = {}
+    for fuse in (False, True):
+        runtime = rt.Runtime(
+            mesh=MeshSpec(data=8), gradient_accumulation_steps=2
+        )
+        cfg = TransformerConfig(**base)
+        mod = _fuse_module(runtime, cfg, fuse)
+        logs = _launch_batches(mod, batches)
+        if fuse:
+            # mid-window launches run nothing
+            assert logs[0] is None and logs[2] is None
+            assert logs[1].synced and logs[3].synced
+        else:
+            assert not logs[0].synced and logs[1].synced
+        assert mod.step == 2  # two effective steps either way
+        params[fuse] = jax.tree_util.tree_map(np.asarray, mod.state.params)
+        mod.destroy()
+
+    flat_a = jax.tree_util.tree_leaves_with_path(params[False])
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(params[True]))
+    for path, leaf in flat_a:
+        np.testing.assert_allclose(
+            leaf, flat_b[path], atol=1e-6, rtol=1e-5,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_fused_window_drives_pipeline_with_scaled_microbatches(devices):
+    """pipe=2 x accum=2 as ONE schedule: pipeline_microbatch_size keeps
+    microbatch rows constant while the fused window doubles the microbatch
+    count through a single GPipe pass; training matches the unfused
+    pipeline run."""
+    import rocket_tpu as rt
+    from rocket_tpu.models.transformer import TransformerConfig
+
+    rng = np.random.default_rng(3)
+    batches = [
+        jax.device_put(
+            {"tokens": jnp.asarray(
+                rng.integers(0, 64, size=(8, 16)), jnp.int32)},
+        )
+        for _ in range(4)
+    ]
+    base = dict(
+        vocab_size=64, hidden=32, n_layers=4, n_heads=4, max_seq=32,
+        attention="dot",
+    )
+    params = {}
+    for fuse in (False, True):
+        runtime = rt.Runtime(
+            mesh=MeshSpec(pipe=2, data=4), gradient_accumulation_steps=2
+        )
+        cfg = TransformerConfig(**base, pipeline_microbatch_size=4)
+        mod = _fuse_module(runtime, cfg, fuse)
+        sharded = [
+            jax.device_put(b, runtime.batch_sharding(ndim=2))
+            for b in batches
+        ]
+        logs = _launch_batches(mod, sharded)
+        final = [l for l in logs if l is not None and l.synced]
+        assert len(final) == 2
+        assert all(np.isfinite(float(l["lm"])) for l in final)
+        assert mod.step == 2
+        params[fuse] = jax.tree_util.tree_map(np.asarray, mod.state.params)
+        mod.destroy()
+    flat_a = jax.tree_util.tree_leaves_with_path(params[False])
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(params[True]))
+    for path, leaf in flat_a:
+        np.testing.assert_allclose(
+            leaf, flat_b[path], atol=2e-5, rtol=1e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
+
+
+def test_fused_window_loss_logging_not_rescaled(devices):
+    """The Loss capsule must report the window mean once, NOT divide the
+    already-averaged fused value by accum again (r4 review finding)."""
+    import rocket_tpu as rt
+    from rocket_tpu.models.transformer import TransformerConfig
+
+    rng = np.random.default_rng(1)
+    batches = [
+        {"tokens": jnp.asarray(rng.integers(0, 64, size=(8, 16)), jnp.int32)}
+        for _ in range(2)
+    ]
+    state_vals = {}
+    for fuse in (False, True):
+        runtime = rt.Runtime(
+            mesh=MeshSpec(data=8), gradient_accumulation_steps=2
+        )
+        cfg = TransformerConfig(
+            vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=32,
+            attention="dot",
+        )
+        mod = _fuse_module(runtime, cfg, fuse)
+        attrs = None
+        import rocket_tpu as rt2
+
+        attrs = rt2.Attributes(
+            looper=rt2.Attributes(grad_enabled=True, state=rt2.Attributes())
+        )
+        for b in batches:
+            attrs.batch = b
+            mod.launch(attrs)
+        state_vals[fuse] = float(attrs.looper.state["lm"])
+        mod.destroy()
+    # both paths log the same window-mean loss (one optimizer step each)
+    np.testing.assert_allclose(
+        state_vals[True], state_vals[False], rtol=1e-5
+    )
+    assert state_vals[True] > 1.0  # ~ln(64); the halved value would be ~2
+
+
+def test_fused_window_rejects_mutable_collections(devices):
+    """BatchNorm-style mutables update once per window under fusion —
+    reject at materialize instead of training with silently different
+    statistics."""
+    import flax.linen as nn
+    import rocket_tpu as rt
+    from rocket_tpu.models.objectives import cross_entropy
+
+    class BNNet(nn.Module):
+        @nn.compact
+        def __call__(self, batch, train: bool = False):
+            x = nn.Dense(8)(batch["x"])
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            out = rt.Attributes(batch)
+            out["logits"] = nn.Dense(4)(x)
+            return out
+
+    runtime = rt.Runtime(mesh=MeshSpec(data=8), gradient_accumulation_steps=2)
+    mod = rt.Module(
+        BNNet(),
+        capsules=[rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                  rt.Optimizer(learning_rate=1e-2)],
+        fuse_accumulation=True,
+    )
+    mod.bind(runtime)
+    mod.setup()
+    batch = {"x": jnp.zeros((8, 4), jnp.float32),
+             "label": jnp.zeros((8,), jnp.int32)}
+    with pytest.raises(RuntimeError, match="mutable"):
+        mod.materialize(batch)
+    mod.destroy()
+
+
+def test_pipeline_knobs_mutually_exclusive_at_construction(devices):
+    from rocket_tpu.models.transformer import TransformerConfig
+
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        TransformerConfig(
+            pipeline_microbatches=2, pipeline_microbatch_size=4
+        )
