@@ -296,8 +296,109 @@ GPT2_TUNE = dict(batch=8, seq=1024, block_q=512, block_k=1024,
                  remat_policy="nothing")
 
 
+_SCAN_CHECK_CACHE: dict = {}
+
+
+def scan_compile_ok(cfg_kwargs: dict, batch: int, seq: int,
+                    timeout_s: float = None) -> tuple:
+    """AOT-compile the scan config (fwd + bwd) in a FRESH subprocess with
+    a timeout; returns ``(ok, detail)``.
+
+    The axon backend's scan miscompile (docs/performance.md "Backend
+    caveat") presents as a fresh-process compile that never finishes,
+    while a warm process "runs" a (near) no-op executable at impossible
+    speed.  A bounded fresh-process compile check separates the two up
+    front, so the bench can fall back to unrolled layers instead of
+    emitting a suspect number (VERDICT r3 next #7).  Result cached per
+    config for the life of the process.
+    """
+    import subprocess
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_SCAN_CHECK_TIMEOUT", 360.0))
+    key = (tuple(sorted(cfg_kwargs.items())), batch, seq, timeout_s)
+    if key in _SCAN_CHECK_CACHE:
+        return _SCAN_CHECK_CACHE[key]
+    repo = os.path.dirname(os.path.abspath(__file__))
+    child = (
+        "import os, sys, jax\n"
+        "if os.environ.get('JAX_PLATFORMS') == 'cpu':\n"
+        "    jax.config.update('jax_platforms', 'cpu')\n"
+        f"sys.path.insert(0, {repo!r})\n"
+        "import jax.numpy as jnp\n"
+        "from rocket_tpu.models.transformer import (\n"
+        "    TransformerConfig, TransformerLM)\n"
+        f"cfg = TransformerConfig.gpt2_124m(**{cfg_kwargs!r})\n"
+        "model = TransformerLM(cfg)\n"
+        f"struct = {{'tokens': jax.ShapeDtypeStruct(({batch}, {seq}), "
+        "jnp.int32)}\n"
+        "params = jax.eval_shape(\n"
+        "    lambda: model.init(\n"
+        "        jax.random.PRNGKey(0),\n"
+        "        jax.tree_util.tree_map(\n"
+        "            lambda s: jnp.zeros(s.shape, s.dtype), struct)))\n"
+        "def fwd(p, b):\n"
+        "    out = model.apply(p, b, train=True)\n"
+        "    return sum(jnp.sum(v.astype(jnp.float32))\n"
+        "               for v in out.values()\n"
+        "               if hasattr(v, 'dtype') and v.ndim > 0)\n"
+        # fwd AND bwd: nn.scan's backward is a separate transposed-scan\n
+        # program — a fwd-only check would pass a bwd-only miscompile.
+        "jax.jit(jax.value_and_grad(fwd)).lower(params, struct).compile()\n"
+        "print('scan-compile-ok')\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", child],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+        if proc.returncode == 0 and "scan-compile-ok" in proc.stdout:
+            result = (True, "ok")
+        else:
+            # Surface the real cause (chip held by this process, import
+            # error, OOM ...) — NOT everything is the scan miscompile.
+            tail = (proc.stderr or "").strip().splitlines()
+            result = (False, tail[-1] if tail else f"exit {proc.returncode}")
+    except subprocess.TimeoutExpired:
+        result = (False, f"compile did not finish within {timeout_s}s")
+    _SCAN_CHECK_CACHE[key] = result
+    return result
+
+
+def resolve_scan_guard(t: dict, check=None) -> tuple:
+    """Apply the scan auto-guard to a merged tune dict: returns
+    ``(tune, fallback_note_or_None)`` — scan configs that fail the
+    bounded fresh-process compile check fall back to unrolled layers."""
+    if not t["scan_layers"]:
+        return t, None
+    check = check if check is not None else scan_compile_ok
+    structural = dict(
+        scan_layers=True, remat=t["remat"],
+        remat_policy=t["remat_policy"], fused_qkv=t["fused_qkv"],
+        fused_ce=t["fused_ce"], fused_ce_chunk=t["ce_chunk"],
+        vocab_size=t["vocab"],
+        attention="auto",
+        attention_block_q=t["block_q"],
+        attention_block_k=t["block_k"],
+    )
+    out = check(structural, t["batch"], t["seq"])
+    ok, detail = out if isinstance(out, tuple) else (bool(out), "")
+    if ok:
+        return t, None
+    note = (
+        f"scan_layers requested, but a bounded fresh-process AOT "
+        f"fwd+bwd compile check did not pass ({detail}; the known axon "
+        f"scan miscompile presents as a never-finishing compile, "
+        f"docs/performance.md) — fell back to unrolled layers"
+    )
+    return dict(t, scan_layers=False), note
+
+
 def bench_gpt2(n_steps, warmup, tune=None):
     t = dict(GPT2_TUNE, **(tune or {}))
+    t, scan_fallback = resolve_scan_guard(t)
+    if scan_fallback is not None:
+        print(json.dumps({"warning": scan_fallback}), flush=True)
     batch, seq = t["batch"], t["seq"]
     cfg = TransformerConfig.gpt2_124m(
         attention="auto",
@@ -337,6 +438,8 @@ def bench_gpt2(n_steps, warmup, tune=None):
                          "published={}); vs_baseline = MFU/0.50 north-star "
                          "proxy",
     })
+    if scan_fallback is not None:
+        rec["scan_fallback"] = scan_fallback
     return rec
 
 
@@ -366,12 +469,20 @@ def sweep_gpt2(n_steps, warmup):
     seen_cfgs = set()
     best = None
     for point in grid:
-        merged = tuple(sorted(dict(GPT2_TUNE, **point).items()))
+        resolved, fallback_note = resolve_scan_guard(
+            dict(GPT2_TUNE, **point)
+        )
+        merged = tuple(sorted(resolved.items()))
         if merged in seen_cfgs:
+            # e.g. the scan point fell back to a config already measured:
+            # record WHY instead of re-benching a mislabeled duplicate.
+            if fallback_note:
+                print(json.dumps({"sweep_point": point, "skipped":
+                                  fallback_note}), flush=True)
             continue
         seen_cfgs.add(merged)
         try:
-            rec = bench_gpt2(n_steps, warmup, tune=point)
+            rec = bench_gpt2(n_steps, warmup, tune=resolved)
         except Exception as exc:
             rec = {"tune": dict(GPT2_TUNE, **point), "value": None,
                    "error": f"{type(exc).__name__}: {exc}"}

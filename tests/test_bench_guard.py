@@ -1,0 +1,71 @@
+"""bench.py scan auto-guard (VERDICT r3 next #7): a scan config that
+fails the bounded fresh-process AOT compile check falls back to unrolled
+layers with a logged note, instead of producing a suspect number."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@pytest.fixture()
+def bench(devices):
+    import bench as bench_mod
+
+    return bench_mod
+
+
+def _tiny_structural():
+    # Small enough that the subprocess compiles in seconds on CPU.
+    return dict(
+        hidden=64, n_layers=2, n_heads=4, max_seq=128, vocab_size=256,
+        scan_layers=True, attention="dot",
+    )
+
+
+def test_scan_compile_ok_on_cpu(bench):
+    ok, detail = bench.scan_compile_ok(_tiny_structural(), batch=2, seq=64)
+    assert ok, detail
+
+
+def test_scan_compile_check_times_out(bench):
+    # A sub-second budget cannot finish interpreter start + compile: the
+    # guard must report broken, not hang.
+    ok, detail = bench.scan_compile_ok(
+        _tiny_structural(), batch=2, seq=64, timeout_s=0.5
+    )
+    assert not ok and "did not finish" in detail
+    # a different timeout is a different cache key: the stale short-budget
+    # False must not leak into default-budget callers
+    ok2, _ = bench.scan_compile_ok(_tiny_structural(), batch=2, seq=64)
+    assert ok2
+
+
+def test_resolve_scan_guard_falls_back(bench):
+    t = dict(bench.GPT2_TUNE, scan_layers=True)
+    out, note = bench.resolve_scan_guard(
+        t, check=lambda *a, **k: (False, "compile did not finish")
+    )
+    assert out["scan_layers"] is False
+    assert note and "fell back to unrolled" in note
+    # everything else untouched
+    assert out["batch"] == t["batch"] and out["block_q"] == t["block_q"]
+
+
+def test_resolve_scan_guard_keeps_healthy_scan(bench):
+    t = dict(bench.GPT2_TUNE, scan_layers=True)
+    out, note = bench.resolve_scan_guard(
+        t, check=lambda *a, **k: (True, "ok")
+    )
+    assert out["scan_layers"] is True and note is None
+
+
+def test_resolve_scan_guard_noop_without_scan(bench):
+    calls = []
+    t = dict(bench.GPT2_TUNE)  # scan_layers False by default
+    out, note = bench.resolve_scan_guard(
+        t, check=lambda *a, **k: calls.append(1) or True
+    )
+    assert out is t and note is None and not calls
