@@ -215,8 +215,42 @@ class Launcher(Dispatcher):
     # -- the run -------------------------------------------------------------
 
     def launch(self, attrs: Optional[Attributes] = None) -> None:
-        """The whole program (reference ``launcher.py:256-291``)."""
+        """The whole program (reference ``launcher.py:256-291``).
+
+        Notebook sugar (reference ``@notebook``, ``launcher.py:202-247``):
+        inside a Jupyter kernel, a plain ``launch()`` that requests more
+        processes than exist (``attrs.launcher.num_procs``) reroutes
+        itself through :func:`~rocket_tpu.launch.notebook.notebook_launch`
+        — each forked worker rendezvouses and re-enters ``launch``.
+        """
         attrs = attrs if attrs is not None else Attributes()
+        requested = (
+            attrs.launcher.num_procs if attrs.launcher is not None else None
+        )
+        if requested is not None and int(requested) > 1:
+            from rocket_tpu.launch import notebook
+
+            # NB: the guard must not call process_count() — that would
+            # initialize a jax backend in the notebook parent, which the
+            # forked workers would inherit broken.  A worker re-entering
+            # launch() is recognized by multihost.is_initialized().
+            if notebook.in_notebook() and not multihost.is_initialized():
+                n = int(requested)
+                self._logger.info(
+                    "notebook detected: rerouting launch through "
+                    "notebook_launch(num_processes=%d)", n,
+                )
+                # Workers rebuild attrs.launcher post-rendezvous (where
+                # multihost is initialized, so this branch cannot
+                # re-trigger).  Hand them a COPY without the launcher
+                # request: notebook_launch can raise, and a retried
+                # launch(attrs) must still see the caller's num_procs.
+                worker_attrs = Attributes(attrs)
+                del worker_attrs.launcher
+                notebook.notebook_launch(
+                    self.launch, args=(worker_attrs,), num_processes=n
+                )
+                return
         attrs.launcher = Attributes(
             num_procs=multihost.process_count(),
             num_nodes=multihost.process_count(),  # one process per TPU host

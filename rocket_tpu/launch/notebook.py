@@ -34,13 +34,11 @@ from typing import Any, Callable, Optional, Sequence
 
 def _backends_initialized() -> bool:
     """True once the parent process has instantiated any XLA backend —
-    after which fork-based workers would inherit broken runtime state."""
-    try:
-        from jax._src import xla_bridge
+    after which fork-based workers would inherit broken runtime state.
+    (Shared probe: fails open on private-API drift, allowing the fork.)"""
+    from rocket_tpu.utils.platform import backends_initialized
 
-        return bool(xla_bridge._backends)
-    except Exception:  # private-API drift: fail open (allow the fork)
-        return False
+    return backends_initialized()
 
 
 def _free_port() -> int:

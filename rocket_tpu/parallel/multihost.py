@@ -134,6 +134,14 @@ def shutdown() -> None:
     _degraded = False
 
 
+def is_initialized() -> bool:
+    """True once :func:`initialize` has run in this process.  Touches NO
+    jax backend state — safe to consult before a fork (the notebook
+    reroute must not initialize a backend the forked children would
+    inherit broken)."""
+    return _initialized
+
+
 def process_index() -> int:
     return jax.process_index()
 

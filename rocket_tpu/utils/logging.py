@@ -34,12 +34,13 @@ def _ensure_root_config() -> None:
 
 
 def _process_index() -> int:
-    try:
-        import jax
+    # Deciding the log rank must never CREATE a backend (a notebook
+    # parent that logs before forking workers would poison the children);
+    # on multi-host, the distributed runtime's id is used so pre-backend
+    # logs still emit once per RUN, not once per host.
+    from rocket_tpu.utils.platform import safe_process_index
 
-        return jax.process_index()
-    except Exception:  # backend not ready yet — behave like rank 0
-        return 0
+    return safe_process_index()
 
 
 class RankAwareLogger:
