@@ -487,6 +487,7 @@ def sweep_gpt2(n_steps, warmup):
             rec = {"tune": dict(GPT2_TUNE, **point), "value": None,
                    "error": f"{type(exc).__name__}: {exc}"}
         print(json.dumps({"sweep_point": point, **rec}), flush=True)
+        _persist_record({"sweep_point": point, **rec})
         # Selection needs a trustworthy measurement: a real value, a real
         # MFU (the gpt2 analytical formula always provides one), and no
         # suspect flag (run_config marks physically impossible >100%-MFU
@@ -495,9 +496,10 @@ def sweep_gpt2(n_steps, warmup):
                 and (best is None or rec["value"] > best["value"])):
             best = rec
     if best is not None:
-        print(json.dumps({"sweep_best": best["tune"],
-                          "value": best["value"], "mfu": best["mfu"]}),
-              flush=True)
+        line = {"sweep_best": best["tune"], "value": best["value"],
+                "mfu": best["mfu"]}
+        print(json.dumps(line), flush=True)
+        _persist_record(line)
 
 
 BENCHES = {
@@ -556,6 +558,24 @@ def main() -> None:
                 "error": f"{type(exc).__name__}: {exc}",
             }
         print(json.dumps(record), flush=True)
+        _persist_record(record)
+
+
+def _persist_record(record: dict) -> None:
+    """Append every ladder record to ``experiments/bench_runs.jsonl`` so
+    ALL lines survive as a committed artifact even when the caller keeps
+    only the final stdout line (round-3 verdict: the resnet/vit numbers
+    were lost that way).  Best-effort: never fails the bench."""
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "experiments", "bench_runs.jsonl",
+        )
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "a") as fh:
+            fh.write(json.dumps({"ts": time.time(), **record}) + "\n")
+    except OSError:
+        pass
 
 
 if __name__ == "__main__":
