@@ -28,14 +28,35 @@ import jax.numpy as jnp
 
 
 def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
-            top_k: Optional[int]) -> jax.Array:
-    """One sampling step on ``[B, V]`` logits."""
+            top_k: Optional[int], top_p: Optional[float] = None) -> jax.Array:
+    """One sampling step on ``[B, V]`` logits (greedy / temperature /
+    top-k / top-p nucleus, composable: top-k truncates first, then the
+    nucleus is taken within what survives)."""
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        # Validate even on the greedy path: a bad top_p must not hide
+        # behind the temperature<=0 early return.
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits.astype(jnp.float32) / temperature
     if top_k is not None:
         kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p is not None:
+        # Nucleus: smallest prefix of the sorted distribution with
+        # cumulative mass >= top_p.  Sorted-space mask scattered back via
+        # argsort-of-argsort (static shapes, no dynamic slicing); one
+        # argsort + one gather, not a second value sort.
+        order = jnp.argsort(logits, axis=-1)[:, ::-1]
+        sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep entries where the mass BEFORE them is < top_p (the first
+        # entry always survives)
+        keep_sorted = (cum - probs) < top_p
+        ranks = jnp.argsort(order, axis=-1)
+        keep = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
+        logits = jnp.where(keep, logits, -jnp.inf)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -47,6 +68,7 @@ def generate(
     rng: Optional[jax.Array] = None,
     temperature: float = 1.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` (``[B, P]``
     int32) with a KV cache; returns ``[B, P + max_new_tokens]`` tokens.
@@ -87,7 +109,7 @@ def generate(
     )
     cache = mutated["cache"]
     rng, sub = jax.random.split(rng)
-    tok = _sample(out["logits"][:, -1], sub, temperature, top_k)
+    tok = _sample(out["logits"][:, -1], sub, temperature, top_k, top_p)
 
     def step(carry, _):
         cache, tok, rng, pos = carry
@@ -100,7 +122,7 @@ def generate(
             decode=True, mutable=["cache"],
         )
         rng, sub = jax.random.split(rng)
-        nxt = _sample(out["logits"][:, 0], sub, temperature, top_k)
+        nxt = _sample(out["logits"][:, 0], sub, temperature, top_k, top_p)
         return (mutated["cache"], nxt, rng, pos + 1), tok
 
     init = (cache, tok, rng, jnp.asarray(P, jnp.int32))
@@ -125,6 +147,7 @@ def generate_seq2seq(
     rng: Optional[jax.Array] = None,
     temperature: float = 0.0,
     top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     pad_id: int = 0,
 ) -> jax.Array:
     """Autoregressive decoding for the encoder-decoder family.
@@ -173,7 +196,7 @@ def generate_seq2seq(
         )
         logits_t = jax.lax.dynamic_slice_in_dim(logits, t, 1, axis=1)[:, 0]
         rng, sub = jax.random.split(rng)
-        nxt = _sample(logits_t, sub, temperature, top_k)
+        nxt = _sample(logits_t, sub, temperature, top_k, top_p)
         buf = jax.lax.dynamic_update_slice_in_dim(
             buf, nxt[:, None], t + 1, axis=1
         )

@@ -134,7 +134,9 @@ class Embed(nn.Module):
             # the MXU — the standard TPU embedding trick.
             one_hot = jax.nn.one_hot(tokens, self.vocab_size, dtype=table.dtype)
             return one_hot @ table
-        return table[tokens]
+        # asarray: host-restored (numpy) params + traced token indices
+        # would otherwise route through numpy's __array__ on the tracer.
+        return jnp.asarray(table)[tokens]
 
     def _vocab_sharded(self) -> bool:
         from rocket_tpu.parallel.context import current_mesh, current_rules

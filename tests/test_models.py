@@ -862,3 +862,53 @@ def test_seq2seq_generate_rejects_overlong_encoder_input(devices):
     vs = nn.meta.unbox(m.init(jax.random.PRNGKey(0), batch))
     with pytest.raises(ValueError, match="encoder inputs"):
         generate_seq2seq(m, vs, inputs, max_new_tokens=2, bos_id=1)
+
+
+def test_top_p_nucleus_sampling(devices):
+    """top_p keeps exactly the smallest prefix of the sorted distribution
+    with cumulative mass >= p; everything outside never samples."""
+    from rocket_tpu.models.generate import _sample
+
+    # masses: .5, .25, .125, .0625, .0625  (index order 0..4)
+    base = jnp.log(jnp.asarray([[0.5, 0.25, 0.125, 0.0625, 0.0625]]))
+    rngs = jax.random.split(jax.random.PRNGKey(0), 300)
+    # p=0.6: nucleus = {0, 1} (0.5 < 0.6, 0.5+0.25 >= 0.6)
+    toks = np.asarray([
+        int(_sample(base, r, 1.0, None, top_p=0.6)[0]) for r in rngs[:150]
+    ])
+    assert set(toks) <= {0, 1}, set(toks)
+    assert {0, 1} <= set(toks)  # both in-nucleus tokens actually occur
+    # p=1.0: full distribution survives
+    toks_full = np.asarray([
+        int(_sample(base, r, 1.0, None, top_p=1.0)[0]) for r in rngs[150:]
+    ])
+    assert len(set(toks_full)) >= 4
+    # tiny p: degenerates to argmax-only support
+    toks_tiny = np.asarray([
+        int(_sample(base, r, 1.0, None, top_p=1e-6)[0]) for r in rngs[:50]
+    ])
+    assert set(toks_tiny) == {0}
+    # composes with top_k (k truncates first)
+    toks_k = np.asarray([
+        int(_sample(base, r, 1.0, 1, top_p=1.0)[0]) for r in rngs[:50]
+    ])
+    assert set(toks_k) == {0}
+    with pytest.raises(ValueError, match="top_p"):
+        _sample(base, rngs[0], 1.0, None, top_p=1.5)
+
+
+def test_generate_with_top_p_runs_under_jit(devices):
+    from rocket_tpu.models.generate import generate
+
+    cfg = TransformerConfig.tiny()
+    m = TransformerLM(cfg)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    vs = nn.meta.unbox(m.init(jax.random.PRNGKey(0), {"tokens": prompt}))
+    import functools
+
+    fn = jax.jit(functools.partial(
+        generate, m, max_new_tokens=5, temperature=0.9, top_p=0.9,
+    ))
+    out = fn(vs["params"], prompt, rng=jax.random.PRNGKey(1))
+    assert out.shape == (2, 9)
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < cfg.vocab_size))
