@@ -137,6 +137,36 @@ def generate(
     return jnp.concatenate([prompt, generated], axis=1)
 
 
+
+def _seq2seq_prepare(model, params, inputs, inputs_mask, max_new_tokens):
+    """Shared seq2seq decode setup: length validation (incl. the
+    learned-positions encoder guard), params normalization, one encoder
+    pass.  Returns ``(variables, memory, total)``."""
+    total = 1 + max_new_tokens
+    if total > model.config.max_seq:
+        raise ValueError(
+            f"1 + max_new_tokens = {total} exceeds max_seq "
+            f"{model.config.max_seq}"
+        )
+    if (
+        model.config.positions == "learned"
+        and inputs.shape[1] > model.config.max_seq
+    ):
+        # Learned positions only have max_seq table rows: the encoder
+        # would die in a confusing (1, max_seq, H)-vs-(B, S, H) broadcast
+        # error — fail with the actual cause instead.  RoPE computes
+        # positions on the fly and handles longer inputs (extrapolated).
+        raise ValueError(
+            f"encoder inputs length {inputs.shape[1]} exceeds max_seq "
+            f"{model.config.max_seq} (learned position table size)"
+        )
+    variables = params if "params" in params else {"params": params}
+    memory = model.apply(
+        variables, inputs, inputs_mask, False, method="encode"
+    )
+    return variables, memory, total
+
+
 def generate_seq2seq(
     model: Any,
     params: Any,
@@ -163,30 +193,11 @@ def generate_seq2seq(
     Returns ``[B, 1 + max_new_tokens]`` tokens (BOS first).
     """
     B = inputs.shape[0]
-    total = 1 + max_new_tokens
-    if total > model.config.max_seq:
-        raise ValueError(
-            f"1 + max_new_tokens = {total} exceeds max_seq "
-            f"{model.config.max_seq}"
-        )
-    if (
-        model.config.positions == "learned"
-        and inputs.shape[1] > model.config.max_seq
-    ):
-        # Learned positions only have max_seq table rows: the encoder
-        # would die in a confusing (1, max_seq, H)-vs-(B, S, H) broadcast
-        # error — fail with the actual cause instead.  RoPE computes
-        # positions on the fly and handles longer inputs (extrapolated).
-        raise ValueError(
-            f"encoder inputs length {inputs.shape[1]} exceeds max_seq "
-            f"{model.config.max_seq} (learned position table size)"
-        )
+    variables, memory, total = _seq2seq_prepare(
+        model, params, inputs, inputs_mask, max_new_tokens
+    )
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    variables = params if "params" in params else {"params": params}
-    memory = model.apply(
-        variables, inputs, inputs_mask, False, method="encode"
-    )
     buf = jnp.full((B, total), pad_id, jnp.int32).at[:, 0].set(bos_id)
 
     def step(carry, t):
@@ -206,3 +217,89 @@ def generate_seq2seq(
         step, (buf, rng), jnp.arange(max_new_tokens)
     )
     return buf
+
+
+def beam_search_seq2seq(
+    model: Any,
+    params: Any,
+    inputs: jax.Array,
+    max_new_tokens: int,
+    bos_id: int,
+    eos_id: int,
+    beam_size: int = 4,
+    inputs_mask: Optional[jax.Array] = None,
+    length_penalty: float = 0.6,
+    pad_id: int = 0,
+) -> tuple:
+    """Beam search for the encoder-decoder family (static shapes).
+
+    Encode once; K beams per row decode over a ``[B*K, 1+T]`` buffer with
+    the same O(T) re-decode as :func:`generate_seq2seq`.  Per step the
+    ``[B, K, V]`` continuation scores reduce with ``lax.top_k`` over the
+    flattened ``K*V`` candidates; finished beams (emitted ``eos_id``) are
+    frozen — they carry exactly one ``pad_id`` continuation at unchanged
+    score, so they stay comparable in the same top-k.  Final ranking uses
+    the GNMT length penalty ``((5 + len) / 6) ** length_penalty``.
+
+    Returns ``(tokens [B, 1+T], scores [B])`` — the best beam per row and
+    its length-normalized log-probability.
+    """
+    B = inputs.shape[0]
+    K, V = beam_size, model.config.vocab_size
+    variables, memory, total = _seq2seq_prepare(
+        model, params, inputs, inputs_mask, max_new_tokens
+    )
+    # tile encoder outputs beam-wise: [B, ...] -> [B*K, ...]
+    tiled_memory = jax.tree_util.tree_map(
+        lambda x: jnp.repeat(x, K, axis=0), memory
+    )
+    tiled_mask = (
+        jnp.repeat(inputs_mask, K, axis=0) if inputs_mask is not None
+        else None
+    )
+
+    buf = jnp.full((B, K, total), pad_id, jnp.int32).at[:, :, 0].set(bos_id)
+    # all beams start identical: beam 0 live at 0.0, the rest at -inf so
+    # the first expansion seeds K DISTINCT continuations
+    scores = jnp.full((B, K), -jnp.inf).at[:, 0].set(0.0)
+    finished = jnp.zeros((B, K), bool)
+    lengths = jnp.zeros((B, K), jnp.int32)  # generated tokens incl. eos
+
+    def step(carry, t):
+        buf, scores, finished, lengths = carry
+        logits = model.apply(
+            variables, buf.reshape(B * K, total), tiled_memory,
+            tiled_mask, False, method="decode",
+        )
+        logits_t = jax.lax.dynamic_slice_in_dim(logits, t, 1, axis=1)[:, 0]
+        logp = jax.nn.log_softmax(
+            logits_t.astype(jnp.float32), axis=-1
+        ).reshape(B, K, V)
+        # finished beams: only the pad continuation, at unchanged score
+        frozen = jnp.full((V,), -jnp.inf).at[pad_id].set(0.0)
+        logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
+        cand = scores[:, :, None] + logp  # [B, K, V]
+        top_scores, top_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+        src_beam = top_idx // V  # which beam each winner extends
+        token = (top_idx % V).astype(jnp.int32)
+        buf = jnp.take_along_axis(buf, src_beam[:, :, None], axis=1)
+        finished = jnp.take_along_axis(finished, src_beam, axis=1)
+        lengths = jnp.take_along_axis(lengths, src_beam, axis=1)
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, token[:, :, None], t + 1, axis=2
+        )
+        lengths = jnp.where(finished, lengths, lengths + 1)
+        finished = finished | (token == eos_id)
+        return (buf, top_scores, finished, lengths), None
+
+    (buf, scores, finished, lengths), _ = jax.lax.scan(
+        step, (buf, scores, finished, lengths),
+        jnp.arange(max_new_tokens),
+    )
+    norm = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** length_penalty
+    final = scores / norm
+    best = jnp.argmax(final, axis=1)
+    tokens = jnp.take_along_axis(
+        buf, best[:, None, None], axis=1
+    )[:, 0]
+    return tokens, jnp.take_along_axis(final, best[:, None], axis=1)[:, 0]
