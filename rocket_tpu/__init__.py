@@ -30,6 +30,7 @@ from rocket_tpu.data import (
 from rocket_tpu.launch import Launcher, Looper, notebook_launch
 from rocket_tpu.observe import (
     Accuracy,
+    ClassStats,
     ImageLogger,
     Meter,
     Metric,
@@ -60,6 +61,7 @@ __all__ = [
     "Loss",
     "notebook_launch",
     "Accuracy",
+    "ClassStats",
     "ImageLogger",
     "Meter",
     "Metric",

@@ -6,7 +6,14 @@ from rocket_tpu.observe.backends import (
     WandbBackend,
 )
 from rocket_tpu.utils.logging import RankAwareLogger, get_logger
-from rocket_tpu.observe.meter import Accuracy, Meter, Metric, Perplexity, StatMetric
+from rocket_tpu.observe.meter import (
+    Accuracy,
+    ClassStats,
+    Meter,
+    Metric,
+    Perplexity,
+    StatMetric,
+)
 from rocket_tpu.observe.profile import Profiler, Throughput, annotate, debug_mode
 from rocket_tpu.observe.tracker import ImageLogger, Tracker
 
@@ -14,6 +21,7 @@ __all__ = [
     "JsonlBackend",
     "MemoryBackend",
     "Accuracy",
+    "ClassStats",
     "Perplexity",
     "Meter",
     "Metric",

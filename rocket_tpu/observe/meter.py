@@ -349,3 +349,86 @@ class Meter(Dispatcher):
         attrs.batch = gathered
         for capsule in self._capsules:
             capsule.launch(attrs)
+
+
+class ClassStats(StatMetric):
+    """Precision / recall / F1 from per-class confusion counts, in
+    in-step form: the device accumulates ``tp/fp/fn`` vectors (one-hot
+    sums — static shapes, one [C] triple per eval cycle crossing to
+    host), ``finalize`` reduces to the requested average.
+
+    ``average='macro'`` (unweighted mean over classes, sklearn
+    ``zero_division=0`` semantics) or ``'micro'`` (global counts — equals
+    accuracy for single-label classification).
+    """
+
+    def __init__(
+        self,
+        num_classes: int,
+        tag: str = "f1",
+        average: str = "macro",
+        logits_key: str = "logits",
+        labels_key: str = "label",
+        **kwargs,
+    ) -> None:
+        if average not in ("macro", "micro"):
+            raise ValueError(
+                f"average must be 'macro' or 'micro', got {average!r}"
+            )
+        super().__init__(tag=tag, **kwargs)
+        self._num_classes = int(num_classes)
+        self._average = average
+        self._logits_key = logits_key
+        self._labels_key = labels_key
+
+    def stats(self, batch: Any) -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+
+        pred = batch[self._logits_key].argmax(-1)
+        label = batch[self._labels_key]
+        valid = batch.get("_valid") if hasattr(batch, "get") else None
+        w = (
+            valid.astype(jnp.float32)
+            if valid is not None
+            else jnp.ones(pred.shape, jnp.float32)
+        )
+        pred_oh = jax.nn.one_hot(pred, self._num_classes) * w[..., None]
+        lab_oh = jax.nn.one_hot(label, self._num_classes) * w[..., None]
+        axes = tuple(range(pred_oh.ndim - 1))
+        return {
+            "tp": (pred_oh * lab_oh).sum(axes),
+            "fp": (pred_oh * (1.0 - lab_oh)).sum(axes),
+            "fn": ((1.0 - pred_oh) * lab_oh).sum(axes),
+        }
+
+    def finalize(self, stats: Dict[str, Any]) -> Dict[str, float]:
+        import numpy as np
+
+        tp = np.asarray(stats["tp"], np.float64)
+        fp = np.asarray(stats["fp"], np.float64)
+        fn = np.asarray(stats["fn"], np.float64)
+        if self._average == "micro":
+            tps, fps, fns = tp.sum(), fp.sum(), fn.sum()
+            prec = tps / max(tps + fps, 1e-12)
+            rec = tps / max(tps + fns, 1e-12)
+            f1 = 2 * prec * rec / max(prec + rec, 1e-12)
+        else:
+            # sklearn macro semantics: per-class P/R/F1 (zero_division=0),
+            # then the UNWEIGHTED MEAN of each — macro-F1 is the mean of
+            # per-class F1, NOT the harmonic mean of macro-P and macro-R.
+            prec_c = np.where(tp + fp > 0, tp / np.maximum(tp + fp, 1e-12), 0.0)
+            rec_c = np.where(tp + fn > 0, tp / np.maximum(tp + fn, 1e-12), 0.0)
+            f1_c = np.where(
+                prec_c + rec_c > 0,
+                2 * prec_c * rec_c / np.maximum(prec_c + rec_c, 1e-12),
+                0.0,
+            )
+            prec, rec, f1 = (
+                float(prec_c.mean()), float(rec_c.mean()), float(f1_c.mean())
+            )
+        return {
+            self._tag: float(f1),
+            f"{self._tag}/precision": float(prec),
+            f"{self._tag}/recall": float(rec),
+        }

@@ -48,25 +48,50 @@ _PREV_HANDLER: dict = {}
 
 
 class Checkpointer(Capsule):
+    """Periodic and/or metric-tracked snapshots.
+
+    ``track_metric``: name of a metric published into the looper state
+    (by a sibling :class:`~rocket_tpu.observe.meter.Metric` — place this
+    capsule in the EVAL looper, after the Meter).  At each cycle end, if
+    the value ranks among the ``keep_best`` best seen (``best_mode``
+    'max'/'min'), the full state snapshots to ``best_dir_format`` and the
+    now-worst best-snapshot is pruned.  Each best dir carries a
+    ``best_metric.json`` so the ranking survives restarts.
+    ``save_every=None`` disables the periodic cadence (best-only use).
+    """
+
     def __init__(
         self,
-        save_every: int = 1000,
+        save_every: Optional[int] = 1000,
         output_dir_format: str = "weights/{:06d}",
         keep_last: Optional[int] = None,
         save_on_cycle_end: bool = False,
         save_on_preemption: bool = True,
+        track_metric: Optional[str] = None,
+        keep_best: int = 1,
+        best_mode: str = "max",
+        best_dir_format: str = "best/{:06d}",
         statefull: bool = True,
         priority: int = 100,
         logger: Optional[Any] = None,
     ) -> None:
         super().__init__(statefull=statefull, priority=priority, logger=logger)
-        if save_every < 1:
-            raise ValueError("save_every must be >= 1")
-        self._save_every = int(save_every)
+        if save_every is not None and save_every < 1:
+            raise ValueError("save_every must be >= 1 (or None to disable)")
+        if best_mode not in ("max", "min"):
+            raise ValueError(f"best_mode must be 'max'/'min', got {best_mode!r}")
+        if keep_best < 1:
+            raise ValueError("keep_best must be >= 1")
+        self._save_every = int(save_every) if save_every is not None else None
         self._format = output_dir_format
         self._keep_last = keep_last
         self._save_on_cycle_end = save_on_cycle_end
         self._save_on_preemption = save_on_preemption
+        self._track_metric = track_metric
+        self._keep_best = int(keep_best)
+        self._best_mode = best_mode
+        self._best_format = best_dir_format
+        self._best: list = []  # (value, path), best first
         self._installed_handler = False
         self._iter_idx = 0
         self._saved_dirs: list = []
@@ -87,12 +112,23 @@ class Checkpointer(Capsule):
         # weights-only resume is a new run seeded from pretrained weights —
         # never delete those.
         self._saved_dirs = []
+        best_roots = [self._runtime.project_dir]
         spec = getattr(self._runtime, "resume_spec", None)
         if spec is not None and spec.load_capsules:
             prior_root = self._strip_format(str(spec.path))
             if prior_root is not None and prior_root != self._runtime.project_dir:
                 self._saved_dirs += self._snapshots_under(prior_root)
+                best_roots.insert(0, prior_root)
         self._saved_dirs += self._snapshots_under(self._runtime.project_dir)
+        if self._track_metric is not None:
+            # The Launcher versions project dirs per launch (v0, v1, ...):
+            # a resumed run's ranking must include the PRIOR run's best
+            # snapshots or a worse post-resume value would "win".
+            best = []
+            for root in best_roots:
+                best += self._scan_best(root)
+            best.sort(key=lambda t: t[0], reverse=self._best_mode == "max")
+            self._best = best[: self._keep_best]
         if (
             self._save_on_preemption
             and threading.current_thread() is threading.main_thread()
@@ -105,30 +141,33 @@ class Checkpointer(Capsule):
             signal.signal(signal.SIGTERM, _on_sigterm)
             self._installed_handler = True
 
-    def _format_parts(self):
+    @staticmethod
+    def _format_parts(fmt: str):
         import re
 
-        field = re.search(r"\{[^}]*\}", self._format)
+        field = re.search(r"\{[^}]*\}", fmt)
         if field is None:
             return None
-        return self._format[: field.start()], self._format[field.end():]
+        return fmt[: field.start()], fmt[field.end():]
 
     def _strip_format(self, snapshot_path: str):
-        """Invert output_dir_format: the project root a snapshot was written
-        under, or None if the path doesn't match the format."""
+        """Invert the snapshot formats (periodic AND best): the project
+        root a snapshot was written under, or None on no match."""
         import re
 
-        parts = self._format_parts()
-        if parts is None:
-            return None
-        prefix, suffix = parts
-        tail = re.compile(
-            re.escape(os.sep) + re.escape(prefix) + r"\d+" + re.escape(suffix) + r"$"
-        )
-        match = tail.search(snapshot_path)
-        if match is None:
-            return None
-        return snapshot_path[: match.start()]
+        for fmt in (self._format, self._best_format):
+            parts = self._format_parts(fmt)
+            if parts is None:
+                continue
+            prefix, suffix = parts
+            tail = re.compile(
+                re.escape(os.sep) + re.escape(prefix) + r"\d+"
+                + re.escape(suffix) + r"$"
+            )
+            match = tail.search(snapshot_path)
+            if match is not None:
+                return snapshot_path[: match.start()]
+        return None
 
     def _snapshots_under(self, root: str) -> list:
         """Snapshot dirs under ``root`` matching output_dir_format, ordered
@@ -136,7 +175,7 @@ class Checkpointer(Capsule):
         import glob
         import re
 
-        parts = self._format_parts()
+        parts = self._format_parts(self._format)
         if parts is None:
             path = os.path.join(root, self._format)
             return [path] if os.path.isdir(path) else []
@@ -169,13 +208,28 @@ class Checkpointer(Capsule):
             return
         # (idx + 1) cadence: first save after save_every iterations, not a
         # useless step-0 snapshot (reference checkpoint.py:116-120 semantics).
-        if (self._iter_idx + 1) % self._save_every == 0:
+        if (
+            self._save_every is not None
+            and (self._iter_idx + 1) % self._save_every == 0
+        ):
             self.save()
         self._iter_idx += 1
 
     def reset(self, attrs: Optional[Attributes] = None) -> None:
         if self._save_on_cycle_end:
             self.save()
+        if self._track_metric is not None and attrs is not None:
+            looper = attrs.looper
+            state = looper.state if looper is not None else None
+            value = state.get(self._track_metric) if state is not None else None
+            if value is not None:
+                self._maybe_save_best(float(value))
+            else:
+                self._logger.warning(
+                    "track_metric=%r: no such value in the looper state at "
+                    "cycle end — is a Meter/Metric publishing it in THIS "
+                    "looper?", self._track_metric,
+                )
 
     def destroy(self, attrs: Optional[Attributes] = None) -> None:
         default_io().wait()  # make the last snapshot durable
@@ -188,12 +242,14 @@ class Checkpointer(Capsule):
 
     # -- save ----------------------------------------------------------------
 
-    def save(self) -> str:
+    def save(self, path: Optional[str] = None) -> str:
         """Snapshot every registered capsule's state (reference
         ``checkpoint.py:83-132``); async, multi-host coordinated."""
-        path = os.path.join(
-            self._runtime.project_dir, self._format.format(self._iter_idx)
-        )
+        track = path is None
+        if path is None:
+            path = os.path.join(
+                self._runtime.project_dir, self._format.format(self._iter_idx)
+            )
         items = {}
         for capsule in self._runtime.checkpointables:
             state = capsule.state_dict()
@@ -206,9 +262,72 @@ class Checkpointer(Capsule):
         self._logger.info("checkpoint -> %s", path)
         # Retention across restarts comes from the setup() disk scan, not
         # from persisting this list.
-        self._saved_dirs.append(path)
-        self._prune()
+        if track:
+            self._saved_dirs.append(path)
+            self._prune()
         return path
+
+    # -- best-k by metric ----------------------------------------------------
+
+    def _better(self, a: float, b: float) -> bool:
+        return a > b if self._best_mode == "max" else a < b
+
+    def _maybe_save_best(self, value: float) -> None:
+        import json
+
+        if len(self._best) >= self._keep_best and not self._better(
+            value, self._best[-1][0]
+        ):
+            return
+        path = os.path.join(
+            self._runtime.project_dir, self._best_format.format(self._iter_idx)
+        )
+        self.save(path)
+        if self._runtime.is_main_process:
+            default_io().wait()  # metadata must describe a durable snapshot
+            with open(os.path.join(path, "best_metric.json"), "w") as fh:
+                json.dump(
+                    {"metric": self._track_metric, "value": value,
+                     "mode": self._best_mode}, fh,
+                )
+        self._best.append((value, path))
+        self._best.sort(key=lambda t: t[0], reverse=self._best_mode == "max")
+        self._logger.info(
+            "best checkpoint (%s=%s) -> %s", self._track_metric, value, path
+        )
+        while len(self._best) > self._keep_best:
+            _, victim = self._best.pop()
+            if self._runtime.is_main_process:
+                shutil.rmtree(victim, ignore_errors=True)
+
+    def _scan_best(self, root: str) -> list:
+        """Reload one root's best-snapshot entries from their metadata
+        (digit-anchored like :meth:`_snapshots_under` — a stray
+        ``best/000001.bak`` must not enter the ranking and get pruned)."""
+        import glob
+        import json
+        import re
+
+        parts = self._format_parts(self._best_format)
+        if parts is None:
+            return []
+        prefix, suffix = parts
+        pattern = re.compile(re.escape(prefix) + r"\d+" + re.escape(suffix) + r"$")
+        best = []
+        for dirpath in glob.glob(os.path.join(root, prefix + "*" + suffix)):
+            if not pattern.match(os.path.relpath(dirpath, root)):
+                continue
+            meta = os.path.join(dirpath, "best_metric.json")
+            if not os.path.isfile(meta):
+                continue
+            try:
+                with open(meta) as fh:
+                    record = json.load(fh)
+            except (OSError, ValueError):
+                continue
+            if record.get("metric") == self._track_metric:
+                best.append((float(record["value"]), dirpath))
+        return best
 
     def _prune(self) -> None:
         if self._keep_last is None or len(self._saved_dirs) <= self._keep_last:

@@ -525,3 +525,172 @@ def test_weights_only_topology_guard(tmp_path, devices):
             launcher2.launch()
     finally:
         Runtime.process_count = orig
+
+
+def test_best_k_checkpoint_by_metric(tmp_path, devices):
+    """Checkpointer(track_metric=...) in the eval looper keeps the
+    keep_best highest-accuracy snapshots with durable metadata, prunes
+    the rest, and reloads the ranking after a restart."""
+    import json
+
+    data = synthetic_classification(n=256)
+
+    def tree(epochs):
+        model = rt.Module(
+            MLP(),
+            capsules=[
+                rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                rt.Optimizer(learning_rate=5e-2),
+            ],
+        )
+        train = rt.Looper(
+            capsules=[
+                rt.Dataset(rt.ArraySource(data), batch_size=64, shuffle=True,
+                           seed=7),
+                model,
+            ],
+            progress=False,
+        )
+        evaluate = rt.Looper(
+            capsules=[
+                rt.Dataset(rt.ArraySource(data), batch_size=64),
+                model,
+                rt.Meter(mode="in_step", capsules=[rt.Accuracy()]),
+                rt.Tracker(backend),
+                rt.Checkpointer(save_every=None, track_metric="accuracy",
+                                keep_best=2),
+            ],
+            grad_enabled=False,
+            progress=False,
+        )
+        return rt.Launcher(
+            capsules=[train, evaluate], tag="best", num_epochs=epochs,
+            project_root=str(tmp_path),
+        )
+
+    backend = MemoryBackend()
+    tree(epochs=4).launch()
+    root = tmp_path / "best" / "v0"
+    best_dirs = sorted(root.glob("best/*"))
+    assert 1 <= len(best_dirs) <= 2, best_dirs
+    metas = []
+    for d in best_dirs:
+        with open(d / "best_metric.json") as fh:
+            metas.append(json.load(fh))
+    assert all(m["metric"] == "accuracy" for m in metas)
+    values = sorted((m["value"] for m in metas), reverse=True)
+    # the kept snapshots are exactly the top-k of EVERY observed cycle
+    observed = sorted(
+        (rec["accuracy"] for _, rec in backend.scalars if "accuracy" in rec),
+        reverse=True,
+    )
+    assert len(observed) == 4  # one eval cycle per epoch
+    np.testing.assert_allclose(values, observed[: len(values)])
+    # no periodic weights/ dirs (save_every=None)
+    assert not (root / "weights").exists()
+
+    # a fresh capsule over the same project dir reloads the ranking
+    ck = rt.Checkpointer(save_every=None, track_metric="accuracy",
+                         keep_best=2)
+    best = ck._scan_best(str(root))
+    assert len(best) == len(best_dirs)
+    assert best[0][0] == values[0]
+
+
+def test_best_checkpoint_resumable(tmp_path, devices):
+    """A best snapshot is a full checkpoint: resume from it."""
+    import jax
+    import jax.numpy as jnp
+
+    data = synthetic_classification(n=128)
+    model = rt.Module(
+        MLP(),
+        capsules=[rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                  rt.Optimizer(learning_rate=5e-2)],
+    )
+    evaluate = rt.Looper(
+        capsules=[
+            rt.Dataset(rt.ArraySource(data), batch_size=64),
+            model,
+            rt.Meter(mode="in_step", capsules=[rt.Accuracy()]),
+            rt.Checkpointer(save_every=None, track_metric="accuracy",
+                            keep_best=1),
+        ],
+        grad_enabled=False,
+        progress=False,
+    )
+    train = rt.Looper(
+        capsules=[
+            rt.Dataset(rt.ArraySource(data), batch_size=64, shuffle=True,
+                       seed=3),
+            model,
+        ],
+        progress=False,
+    )
+    # ONE epoch: the single eval cycle's best snapshot IS the final state
+    # (a second epoch could skip its save when the metric saturates).
+    rt.Launcher(capsules=[train, evaluate], tag="bestres", num_epochs=1,
+                project_root=str(tmp_path)).launch()
+    best = sorted((tmp_path / "bestres" / "v0" / "best").iterdir())[-1]
+    trained = jax.tree_util.tree_map(np.asarray, model.state.params)
+
+    launcher2, model2 = _tree(
+        tmp_path, data, epochs=0, resume=str(best), load_capsules=False,
+        input_spec={
+            "x": jax.ShapeDtypeStruct((64, 16), jnp.float32),
+            "label": jax.ShapeDtypeStruct((64,), jnp.int32),
+        },
+    )
+    launcher2.launch()
+    restored = jax.tree_util.tree_map(np.asarray, model2.state.params)
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, trained, restored
+    )
+
+
+def test_best_ranking_survives_versioned_restart(tmp_path, devices):
+    """The Launcher gives a resumed run a fresh v{N} dir; the best-k
+    ranking must seed from the PRIOR version's best dirs (resume path
+    itself a best/ snapshot) or a worse post-resume value would win."""
+    import json
+
+    data = synthetic_classification(n=128)
+
+    def tree(epochs, resume=None):
+        model = rt.Module(
+            MLP(),
+            capsules=[rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                      rt.Optimizer(learning_rate=5e-2)],
+        )
+        ck = rt.Checkpointer(save_every=None, track_metric="accuracy",
+                             keep_best=2)
+        evaluate = rt.Looper(
+            capsules=[rt.Dataset(rt.ArraySource(data), batch_size=64),
+                      model,
+                      rt.Meter(mode="in_step", capsules=[rt.Accuracy()]),
+                      ck],
+            grad_enabled=False, progress=False,
+        )
+        train = rt.Looper(
+            capsules=[rt.Dataset(rt.ArraySource(data), batch_size=64,
+                                 shuffle=True, seed=3), model],
+            progress=False,
+        )
+        launcher = rt.Launcher(capsules=[train, evaluate], tag="bestv",
+                               num_epochs=epochs,
+                               project_root=str(tmp_path))
+        if resume:
+            launcher.resume(resume)
+        return launcher, ck
+
+    launcher, _ = tree(epochs=1)
+    launcher.launch()
+    best = sorted((tmp_path / "bestv" / "v0" / "best").iterdir())[-1]
+    with open(best / "best_metric.json") as fh:
+        v0_value = json.load(fh)["value"]
+
+    launcher2, ck2 = tree(epochs=0, resume=str(best))
+    launcher2.launch()  # v1 project dir; no epochs run
+    assert ck2._best, "ranking not seeded from the prior version"
+    assert ck2._best[0][0] == v0_value
+    assert "v0" in ck2._best[0][1]

@@ -3,6 +3,7 @@
 import json
 import os
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -317,3 +318,85 @@ class TestPerplexity:
         a = self._run(self._batches(with_nll=False))
         b = self._run(self._batches(with_nll=True))
         assert a == pytest.approx(b, rel=1e-5)
+
+
+class TestClassStats:
+    def _eval(self, logits, labels, valid=None, **kw):
+        import jax
+
+        m = rt.ClassStats(num_classes=3, **kw)
+        batch = rt.Attributes(
+            logits=jnp.asarray(logits, jnp.float32),
+            label=jnp.asarray(labels, jnp.int32),
+        )
+        if valid is not None:
+            batch["_valid"] = jnp.asarray(valid)
+        stats = m.stats(batch)
+        return m.finalize(jax.tree_util.tree_map(np.asarray, stats))
+
+    def test_macro_matches_sklearn_style_hand_calc(self, devices):
+        # preds: [0, 1, 1, 2]; labels: [0, 1, 2, 2]
+        logits = np.eye(3)[[0, 1, 1, 2]] * 5
+        labels = [0, 1, 2, 2]
+        out = self._eval(logits, labels, average="macro")
+        # per class: c0 p=1 r=1 f1=1; c1 p=.5 r=1 f1=2/3; c2 p=1 r=.5
+        # f1=2/3.  sklearn macro-F1 = mean of per-class F1 (NOT the
+        # harmonic mean of macro-P and macro-R).
+        prec, rec = (1 + 0.5 + 1) / 3, (1 + 1 + 0.5) / 3
+        np.testing.assert_allclose(out["f1/precision"], prec, rtol=1e-6)
+        np.testing.assert_allclose(out["f1/recall"], rec, rtol=1e-6)
+        np.testing.assert_allclose(
+            out["f1"], (1.0 + 2 / 3 + 2 / 3) / 3, rtol=1e-6
+        )
+
+    def test_micro_equals_accuracy(self, devices):
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 3, 64)
+        logits = rng.normal(size=(64, 3))
+        out = self._eval(logits, labels, average="micro")
+        acc = float((logits.argmax(-1) == labels).mean())
+        np.testing.assert_allclose(out["f1"], acc, rtol=1e-6)
+
+    def test_valid_mask_drops_padded_rows(self, devices):
+        logits = np.eye(3)[[0, 1, 2, 0]] * 5
+        labels = [0, 1, 2, 2]  # row 3 wrong — but masked out
+        out = self._eval(logits, labels, valid=[True, True, True, False])
+        np.testing.assert_allclose(out["f1"], 1.0, rtol=1e-6)
+
+    def test_through_meter_in_step(self, devices):
+        """Summed across batches through the in-step Meter path."""
+        meter = rt.Meter(
+            mode="in_step",
+            capsules=[rt.ClassStats(num_classes=3, average="micro")],
+        )
+        meter.bind(rt.Runtime())
+        meter.setup()
+        rng = np.random.default_rng(1)
+        attrs = rt.Attributes(
+            looper=rt.Attributes(grad_enabled=False, state=rt.Attributes())
+        )
+        all_logits, all_labels = [], []
+        for _ in range(3):
+            logits = rng.normal(size=(16, 3))
+            labels = rng.integers(0, 3, 16)
+            all_logits.append(logits)
+            all_labels.append(labels)
+            attrs.batch = rt.Attributes(
+                logits=jnp.asarray(logits, jnp.float32),
+                label=jnp.asarray(labels, jnp.int32),
+            )
+            meter.launch(attrs)
+        meter.reset(attrs)
+        want = float(
+            (np.concatenate(all_logits).argmax(-1)
+             == np.concatenate(all_labels)).mean()
+        )
+        np.testing.assert_allclose(
+            float(attrs.looper.state["f1"]), want, rtol=1e-6
+        )
+        assert "f1" in next(iter(meter._capsules)).last
+        meter.destroy()
+
+    def test_rejects_bad_average(self, devices):
+        with pytest.raises(ValueError, match="average"):
+            rt.ClassStats(num_classes=3, average="weighted")
