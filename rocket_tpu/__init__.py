@@ -23,7 +23,9 @@ from rocket_tpu.data import (
     ArraySource,
     DataLoader,
     Dataset,
+    ConcatSource,
     GeneratorSource,
+    MapSource,
     IterableSource,
     TokenFileSource,
 )
@@ -54,7 +56,9 @@ __all__ = [
     "Dataset",
     "Dispatcher",
     "Events",
+    "ConcatSource",
     "GeneratorSource",
+    "MapSource",
     "IterableSource",
     "Launcher",
     "Looper",

@@ -42,6 +42,7 @@ class Dataset(Capsule):
         collate_fn: Optional[Callable] = None,
         prefetch: int = 2,
         shuffle_buffer: int = 1024,
+        num_workers: int = 0,
         loader: Optional[DataLoader] = None,
         statefull: bool = True,
         priority: int = 1000,
@@ -60,6 +61,7 @@ class Dataset(Capsule):
             collate_fn=collate_fn,
             prefetch=prefetch,
             shuffle_buffer=shuffle_buffer,
+            num_workers=num_workers,
         )
         self._iterator = None
         self._total: Optional[int] = None

@@ -42,6 +42,49 @@ import numpy as np
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.utils.placement import collate as default_collate
 
+# Fork-inherited worker state (NOT passed through initargs: pickling a
+# large in-memory source per worker would copy it through a pipe; fork
+# inherits the parent's pages copy-on-write for free).  Keyed by a
+# per-pool token so concurrently-starting loaders cannot clobber each
+# other's entry; the parent pops its token once the pool is forked.
+_WORKER_STATE: dict = {}
+_WORKER_TOKEN_LOCK = threading.Lock()
+_WORKER_TOKEN_COUNTER = [0]
+
+
+def _wrap_batch(batch: Any, valid: np.ndarray, mask_key: str) -> Any:
+    """Collated batch -> Attributes with the validity mask (the ONE
+    wrapping invariant, shared by the in-process and worker paths)."""
+    if not isinstance(batch, (dict, Attributes)):
+        batch = Attributes(data=batch)
+    batch = Attributes(batch)
+    batch[mask_key] = valid
+    return batch
+
+
+def _worker_init(token: int, seed: int) -> None:
+    import os
+    import random
+
+    global _WORKER_ENTRY
+    _WORKER_ENTRY = _WORKER_STATE[token]
+    # Decorrelate per-worker RNG streams for sources that use the global
+    # numpy/python RNGs in __getitem__ (torch's worker_init_fn concern);
+    # forked children otherwise inherit IDENTICAL rng state.
+    random.seed((seed, os.getpid()).__hash__())
+    np.random.seed((seed ^ os.getpid()) % (2**32))
+
+
+def _worker_batch(args: tuple) -> Any:
+    """Runs in a forked worker: pure numpy/python — must NOT touch jax
+    (a backend init in a forked child could grab the parent's TPU)."""
+    idx_local, valid_local = args
+    state = _WORKER_ENTRY
+    samples = [state["source"][int(i)] for i in idx_local]
+    return _wrap_batch(
+        state["collate"](samples), valid_local, state["mask_key"]
+    )
+
 
 class DataLoader:
     """Parameters
@@ -69,6 +112,13 @@ class DataLoader:
         ``runtime.batch_sharding()``). ``None`` keeps batches on host.
     prefetch:
         Number of batches staged ahead (0 disables the background thread).
+    num_workers:
+        Map-style sources only: fork this many worker PROCESSES that
+        fetch + collate batches in parallel (the reference's torch
+        DataLoader workers, SURVEY §2.1) — for CPU-bound ``__getitem__``
+        transforms the GIL caps what the prefetch thread alone can
+        overlap.  Workers are pure numpy (no jax); requires the ``fork``
+        start method (Linux).  0 = in-process (default).
     """
 
     def __init__(
@@ -83,6 +133,8 @@ class DataLoader:
         prefetch: int = 2,
         mask_key: str = "_valid",
         shuffle_buffer: int = 1024,
+        num_workers: int = 0,
+        worker_timeout: float = 300.0,
     ) -> None:
         if batch_size < 1:
             raise ValueError("batch_size must be >= 1")
@@ -97,11 +149,20 @@ class DataLoader:
         self.mask_key = mask_key
         self.shuffle_buffer = int(shuffle_buffer)
         self.epoch = 0
+        self.num_workers = int(num_workers)
+        self.worker_timeout = float(worker_timeout)
         self.streaming = not hasattr(source, "__len__")
         if self.streaming and not hasattr(source, "__iter__"):
             raise TypeError(
                 f"source {type(source).__name__} is neither map-style "
                 f"(__len__ + __getitem__) nor iterable (__iter__)"
+            )
+        if self.num_workers < 0:
+            raise ValueError("num_workers must be >= 0")
+        if self.num_workers > 0 and self.streaming:
+            raise ValueError(
+                "num_workers requires a map-style source (a stream is "
+                "inherently sequential); use prefetch for streams"
             )
 
         procs = jax.process_count()
@@ -272,12 +333,7 @@ class DataLoader:
         yield self._collate_local(rows, valid)
 
     def _collate_local(self, samples: list, valid: np.ndarray) -> Any:
-        batch = self.collate_fn(samples)
-        if not isinstance(batch, (dict, Attributes)):
-            batch = Attributes(data=batch)
-        batch = Attributes(batch)
-        batch[self.mask_key] = valid
-        return batch
+        return _wrap_batch(self.collate_fn(samples), valid, self.mask_key)
 
     # -- iteration ----------------------------------------------------------
 
@@ -294,41 +350,159 @@ class DataLoader:
             plan = self._batch_indices(epoch)
             for _ in range(skip_batches):
                 next(plan, None)
-            host_iter = (
-                self._host_batch(idx, valid) for idx, valid in plan
-            )
+            if self.num_workers > 0:
+                host_iter = self._pool_host_batches(plan)
+            else:
+                host_iter = (
+                    self._host_batch(idx, valid) for idx, valid in plan
+                )
         if self.prefetch <= 0:
             for host_batch in host_iter:
                 yield self._to_device(host_batch)
             return
         yield from self._prefetch_iter(host_iter)
 
+    def _pool_host_batches(self, plan: Iterator[tuple]) -> Iterator[Any]:
+        """Host batches via a fork pool of worker processes.  The parent
+        precomputes each worker task's LOCAL index slice (workers must not
+        call jax.process_index() — no jax in forked children), submits up
+        to ``num_workers + prefetch`` tasks ahead, and consumes results in
+        submission order (determinism)."""
+        import multiprocessing as mp
+        import sys
+        from collections import deque
+
+        if not sys.platform.startswith("linux"):
+            # fork from a multithreaded jax process is only dependable on
+            # Linux (macOS ObjC runtime aborts forked children even when
+            # they never touch inherited state).
+            self._warn_no_fork()
+            for idx, valid in plan:
+                yield self._host_batch(idx, valid)
+            return
+        p = jax.process_index()  # in the PARENT, before forking
+        lo = p * self.local_batch_size
+        hi = lo + self.local_batch_size
+        with _WORKER_TOKEN_LOCK:
+            _WORKER_TOKEN_COUNTER[0] += 1
+            token = _WORKER_TOKEN_COUNTER[0]
+        _WORKER_STATE[token] = dict(
+            source=self.source,
+            collate=self.collate_fn,
+            mask_key=self.mask_key,
+        )
+        ctx = mp.get_context("fork")
+        import warnings
+
+        with warnings.catch_warnings():
+            # Python 3.12 warns on fork-from-multithreaded (jax's runtime
+            # threads).  Accepted deliberately, like torch's fork-based
+            # workers: the children run ONLY the pure-numpy _worker_batch
+            # and never call into inherited jax/XLA state, which is where
+            # the deadlock hazard lives.
+            warnings.filterwarnings(
+                "ignore", message=".*fork.*", category=DeprecationWarning
+            )
+            warnings.filterwarnings(
+                "ignore", message=".*fork.*", category=RuntimeWarning
+            )
+            pool = ctx.Pool(
+                self.num_workers, initializer=_worker_init,
+                initargs=(token, self.seed),
+            )
+        # Children inherited their copy at fork: drop the parent's
+        # reference so a discarded loader's (possibly multi-GB) source is
+        # collectable.
+        _WORKER_STATE.pop(token, None)
+        try:
+            depth = self.num_workers + max(self.prefetch, 1)
+            pending: deque = deque()
+
+            def result(async_result):
+                try:
+                    return async_result.get(timeout=self.worker_timeout)
+                except mp.TimeoutError:
+                    raise RuntimeError(
+                        f"data worker produced no batch within "
+                        f"{self.worker_timeout}s — a worker was likely "
+                        f"killed out-of-band (OOM?); lower num_workers or "
+                        f"the per-sample memory footprint"
+                    ) from None
+
+            for idx, valid in plan:
+                pending.append(
+                    pool.apply_async(_worker_batch, ((idx[lo:hi], valid[lo:hi]),))
+                )
+                if len(pending) >= depth:
+                    yield result(pending.popleft())
+            while pending:
+                yield result(pending.popleft())
+        finally:
+            pool.terminate()
+            pool.join()
+
+    def _warn_no_fork(self) -> None:  # pragma: no cover - non-Linux only
+        import warnings
+
+        warnings.warn(
+            "num_workers>0 needs the 'fork' start method (unavailable on "
+            "this platform); falling back to in-process loading",
+            RuntimeWarning,
+            stacklevel=3,
+        )
+
     def _prefetch_iter(self, host_iter: Iterator[Any]) -> Iterator[Any]:
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
         error: list = []
+        cancel = threading.Event()
 
         def producer() -> None:
             try:
                 for host_batch in host_iter:
-                    q.put(host_batch)
+                    # Cancellation-aware put: when the consumer abandons
+                    # iteration (break / partial eval), a plain q.put
+                    # would block forever and strand this thread — and,
+                    # with num_workers>0, the worker POOL whose cleanup
+                    # lives in host_iter's finally.
+                    while not cancel.is_set():
+                        try:
+                            q.put(host_batch, timeout=0.2)
+                            break
+                        except queue.Full:
+                            continue
+                    if cancel.is_set():
+                        return
             except BaseException as exc:  # propagate into consumer
                 error.append(exc)
             finally:
-                q.put(sentinel)
-
+                close = getattr(host_iter, "close", None)
+                if close is not None:
+                    close()  # runs the pool generator's finally (terminate)
+                # The sentinel must actually ARRIVE (a dropped sentinel
+                # leaves the consumer blocked in q.get forever) — block
+                # for space unless the consumer already cancelled.
+                while not cancel.is_set():
+                    try:
+                        q.put(sentinel, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()
-        staged = None
-        while True:
-            item = q.get()
-            if item is sentinel:
-                if error:
-                    raise error[0]
-                break
-            device_batch = self._to_device(item)
+        try:
+            staged = None
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    if error:
+                        raise error[0]
+                    break
+                device_batch = self._to_device(item)
+                if staged is not None:
+                    yield staged
+                staged = device_batch
             if staged is not None:
                 yield staged
-            staged = device_batch
-        if staged is not None:
-            yield staged
+        finally:
+            cancel.set()  # abandoned mid-epoch: unblock + clean up producer
