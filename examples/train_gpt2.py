@@ -46,6 +46,12 @@ def main():
     )
     parser.add_argument("--resume", type=str, default=None)
     parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument(
+        "--muon", action="store_true",
+        help="Muon on hidden matrices + adamw on embeddings/rest "
+             "(engine.muon; the paper's recommended split via param "
+             "groups)",
+    )
     parser.add_argument("--batch", type=int, default=8)
     parser.add_argument("--accum", type=int, default=2)
     parser.add_argument(
@@ -84,14 +90,38 @@ def main():
         init_value=0.0, peak_value=3e-4, warmup_steps=20,
         decay_steps=500, end_value=3e-5,
     )
-    model = rt.Module(
-        TransformerLM(cfg),
-        capsules=[
-            rt.Loss(lm_cross_entropy(), name="lm"),
+    if args.muon:
+        from rocket_tpu.engine.muon import hidden_matrices, muon
+
+        # Muon gets its OWN warmup/decay (scaled to its 0.02 peak): a
+        # ready tx= would take full-size orthogonalized steps from step 0
+        # and never anneal, while the sibling Scheduler paces adamw only.
+        muon_schedule = optax.warmup_cosine_decay_schedule(
+            init_value=0.0, peak_value=0.02, warmup_steps=20,
+            decay_steps=500, end_value=0.002,
+        )
+        optimizers = [
+            rt.Optimizer(tx_factory=muon, params_filter=hidden_matrices,
+                         schedule=muon_schedule, tag="lr_muon"),
+            rt.Optimizer(
+                tx_factory=optax.adamw, learning_rate=3e-4,
+                grad_clip_norm=1.0, weight_decay=0.1,
+                params_filter=lambda p, x: not hidden_matrices(p, x),
+                tag="lr_adamw",
+            ),
+        ]
+    else:
+        optimizers = [
             rt.Optimizer(
                 tx_factory=optax.adamw, learning_rate=3e-4,
                 grad_clip_norm=1.0, weight_decay=0.1,
             ),
+        ]
+    model = rt.Module(
+        TransformerLM(cfg),
+        capsules=[
+            rt.Loss(lm_cross_entropy(), name="lm"),
+            *optimizers,
             rt.Scheduler(schedule),
         ],
     )

@@ -1,5 +1,5 @@
 from rocket_tpu.engine.adapter import FlaxModel, ModelAdapter, state_shardings
-from rocket_tpu.engine.muon import muon, orthogonalize
+from rocket_tpu.engine.muon import hidden_matrices, muon, orthogonalize
 from rocket_tpu.engine.precision import Policy
 from rocket_tpu.engine.state import TrainState, param_count
 from rocket_tpu.engine.step import (
@@ -12,6 +12,7 @@ from rocket_tpu.engine.step import (
 __all__ = [
     "FlaxModel",
     "ModelAdapter",
+    "hidden_matrices",
     "muon",
     "orthogonalize",
     "Objective",

@@ -9,13 +9,15 @@ MXU work, which is exactly what a TPU wants (no SVD, no host sync).
 
 Scope contract (the paper's): Muon is for the HIDDEN 2D matrices.
 Embeddings, unembeddings, biases, norms should use adamw — compose with
-the capsule API's param groups::
+the capsule API's param groups (:func:`hidden_matrices` is the canonical
+split)::
 
-    hidden = lambda p, x: x.ndim == 2 and "embed" not in str(p)
+    from rocket_tpu.engine.muon import hidden_matrices, muon
+    rest = lambda p, x: not hidden_matrices(p, x)
     rt.Module(model, capsules=[
         rt.Loss(...),
         rt.Optimizer(tx_factory=muon, learning_rate=0.02,
-                     params_filter=hidden, tag="lr_muon"),
+                     params_filter=hidden_matrices, tag="lr_muon"),
         rt.Optimizer(learning_rate=3e-4, params_filter=rest, tag="lr_adam"),
     ])
 
@@ -61,6 +63,19 @@ def orthogonalize(g: jax.Array, steps: int = 5,
 
     x, _ = jax.lax.scan(body, x, None, length=steps)
     return x.T if transpose else x
+
+
+def hidden_matrices(path, leaf: Any = None) -> bool:
+    """The paper's Muon scope as a param filter: 2D kernels that are not
+    embedding/unembedding tables (matched by an ``embed`` path
+    component).  Pass as ``Optimizer(params_filter=hidden_matrices)``;
+    route everything else to adamw."""
+    if getattr(leaf, "ndim", None) != 2:
+        return False
+    return not any(
+        "embed" in str(getattr(p, "key", getattr(p, "name", ""))).lower()
+        for p in path
+    )
 
 
 class MuonState(NamedTuple):

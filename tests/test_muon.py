@@ -76,12 +76,7 @@ def test_muon_param_groups_with_adamw(devices):
     from rocket_tpu.models.objectives import lm_cross_entropy
     from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
 
-    def is_hidden_matrix(path, leaf):
-        return (
-            getattr(leaf, "ndim", 0) == 2
-            and not any("embed" in str(getattr(p, "key", "")).lower()
-                        for p in path)
-        )
+    from rocket_tpu.engine.muon import hidden_matrices as is_hidden_matrix
 
     def is_rest(path, leaf):
         return not is_hidden_matrix(path, leaf)
