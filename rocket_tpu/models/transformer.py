@@ -382,6 +382,23 @@ class Block(nn.Module):
         return constrain(x, "batch", "sequence", "act_embed"), aux
 
 
+def remat_policies(cfg: TransformerConfig):
+    """Resolve ``cfg.remat_policy`` to a jax checkpoint policy (shared by
+    the sequential/scanned stack and the pipelined stage fn)."""
+    policies = {
+        "nothing": None,  # jax default: save nothing
+        "dots": jax.checkpoint_policies.checkpoint_dots,
+        "dots_no_batch":
+            jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
+    }
+    if cfg.remat_policy not in policies:
+        raise ValueError(
+            f"unknown remat_policy {cfg.remat_policy!r}; "
+            f"choose from {sorted(policies)}"
+        )
+    return policies[cfg.remat_policy]
+
+
 class PipelinedBlocks(nn.Module):
     """The block stack, GPipe-pipelined over the mesh's ``pipe`` axis.
 
@@ -444,6 +461,15 @@ class PipelinedBlocks(nn.Module):
             )
             return (out, pos) + ((seg,) if has_seg else ())
 
+        if cfg.remat:
+            # GPipe's backward (the transposed rotation) otherwise keeps
+            # EVERY microbatch's per-layer activations alive through the
+            # whole schedule — remat per layer application recomputes
+            # them instead, same policy knob as the sequential stack.
+            one_layer = jax.checkpoint(
+                one_layer, policy=remat_policies(cfg), prevent_cse=False
+            )
+
         xs = (
             x.reshape(n_micro, micro_b, S, D),
             positions.reshape(n_micro, micro_b, S),
@@ -473,15 +499,6 @@ class TransformerLM(nn.Module):
                 "unrolled layer layout: scan_layers=False, remat=False, "
                 "no pipelining (pipeline_microbatches=0 and "
                 "pipeline_microbatch_size=0)"
-            )
-        if cfg.remat and cfg.pipelined:
-            # PipelinedBlocks does not thread the remat wrap; rejecting the
-            # combination beats silently training without rematerialization
-            # at a batch size the user sized for remat.
-            raise ValueError(
-                "remat=True is not supported with pipeline_microbatches>0 "
-                "(the pipeline already bounds activation memory per "
-                "microbatch; set remat=False)"
             )
         tokens = batch[self.tokens_key]
         B, S = tokens.shape
@@ -514,21 +531,16 @@ class TransformerLM(nn.Module):
 
         block_cls = Block
         if cfg.remat:
-            policies = {
-                "nothing": None,  # jax default: save nothing
-                "dots": jax.checkpoint_policies.checkpoint_dots,
-                "dots_no_batch":
-                    jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims,
-            }
-            if cfg.remat_policy not in policies:
-                raise ValueError(
-                    f"unknown remat_policy {cfg.remat_policy!r}; "
-                    f"choose from {sorted(policies)}"
+            # Validate the policy name up front for EVERY layout — the
+            # pipelined branch applies its own jax.checkpoint wrap after
+            # the init early-return, which would defer an unknown-policy
+            # error to the first real apply.
+            policy = remat_policies(cfg)
+            if not cfg.pipelined:
+                block_cls = nn.remat(
+                    Block, static_argnums=(4,), prevent_cse=False,
+                    policy=policy,
                 )
-            block_cls = nn.remat(
-                Block, static_argnums=(4,), prevent_cse=False,
-                policy=policies[cfg.remat_policy],
-            )
         if cfg.pipelined:
             x = PipelinedBlocks(cfg, name="pipeline")(
                 x, positions, segment_ids, train

@@ -737,10 +737,42 @@ def test_transformer_remat_policy_unknown_rejected(devices):
         )
 
 
-def test_transformer_remat_pipeline_combo_rejected(devices):
-    cfg = TransformerConfig.tiny(remat=True, pipeline_microbatches=2)
-    with pytest.raises(ValueError, match="remat.*pipeline|pipeline.*remat"):
-        TransformerLM(cfg).init(jax.random.PRNGKey(0), _lm_batch(B=2, S=32))
+def test_transformer_remat_inside_pipeline_matches(devices):
+    """remat composes with the pipeline (GPipe's backward otherwise holds
+    every microbatch's activations): checkpointed stage fn must reproduce
+    the unremat'd pipeline's loss AND parameter gradients exactly."""
+    from rocket_tpu.models.objectives import lm_cross_entropy as lm_ce
+    from rocket_tpu.parallel.context import mesh_context
+    from rocket_tpu.parallel.mesh import MeshSpec
+
+    mesh = MeshSpec(pipe=2, data=4).build(jax.devices())
+    base = dict(
+        vocab_size=64, hidden=32, n_layers=4, n_heads=4, max_seq=32,
+        attention="dot", pipeline_microbatches=2,
+    )
+    batch = _lm_batch(vocab=64, B=4, S=16)
+    results = {}
+    with mesh_context(mesh):
+        for remat in (False, True):
+            cfg = TransformerConfig(**base, remat=remat,
+                                    remat_policy="dots" if remat else "nothing")
+            m = TransformerLM(cfg)
+            if not results:
+                vs = nn.meta.unbox(m.init(jax.random.PRNGKey(0), batch))
+
+            def loss(params, m=m):
+                return lm_ce()(m.apply({"params": params}, batch, train=True))
+
+            value, grads = jax.value_and_grad(loss)(vs["params"])
+            results[remat] = (float(value), grads)
+    np.testing.assert_allclose(results[False][0], results[True][0], rtol=1e-6)
+    flat_a = jax.tree_util.tree_leaves_with_path(results[False][1])
+    flat_b = dict(jax.tree_util.tree_leaves_with_path(results[True][1]))
+    for path, leaf in flat_a:
+        np.testing.assert_allclose(
+            np.asarray(leaf), np.asarray(flat_b[path]), atol=1e-5, rtol=1e-4,
+            err_msg=jax.tree_util.keystr(path),
+        )
 
 
 def test_lm_z_loss_parity_fused_vs_logits(devices):
