@@ -37,11 +37,37 @@ def synthetic_cifar(n=4096, seed=0):
     }
 
 
+def augment(sample):
+    """Standard CIFAR train-time augmentation (random crop with 4px pad +
+    horizontal flip) — pure numpy per sample, so fork workers
+    (``--workers``) parallelize it off the host's critical path.  Uses
+    the process-global RNG: crops vary per epoch, and the loader's
+    worker init decorrelates the streams across forked workers."""
+    img = sample["image"]
+    padded = np.pad(img, ((4, 4), (4, 4), (0, 0)), mode="reflect")
+    dy, dx = np.random.randint(0, 9, size=2)
+    img = padded[dy:dy + 32, dx:dx + 32]
+    if np.random.randint(2):
+        img = img[:, ::-1]
+    return {**sample, "image": np.ascontiguousarray(img)}
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--data", type=str, default=None)
     parser.add_argument("--small", action="store_true", help="ResNet-8-ish for CPU")
     parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument(
+        "--workers", type=int, default=0,
+        help="fork worker processes for the data pipeline "
+             "(Dataset num_workers)",
+    )
+    parser.add_argument(
+        "--augment", action="store_true",
+        help="random-crop + flip train augmentation (use with real CIFAR "
+             "--data; the synthetic protos task is pixel-aligned and "
+             "augmentation defeats it)",
+    )
     args = parser.parse_args()
 
     if args.data:
@@ -72,7 +98,12 @@ def main():
         capsules=[
             rt.Looper(
                 capsules=[
-                    rt.Dataset(rt.ArraySource(data), batch_size=256, shuffle=True),
+                    rt.Dataset(
+                        rt.MapSource(rt.ArraySource(data), augment)
+                        if args.augment else rt.ArraySource(data),
+                        batch_size=256, shuffle=True,
+                        num_workers=args.workers,
+                    ),
                     model,
                     rt.Tracker("jsonl"),
                 ]
