@@ -565,3 +565,63 @@ def test_pipeline_knobs_mutually_exclusive_at_construction(devices):
         TransformerConfig(
             pipeline_microbatches=2, pipeline_microbatch_size=4
         )
+
+
+def test_fused_window_resume_restarts_window(devices, tmp_path):
+    """fuse_accumulation: a checkpoint landing mid-window resumes by
+    RESTARTING the window (documented contract — no grad_accum buffer
+    exists to checkpoint); step counters stay consistent and training
+    continues."""
+    import rocket_tpu as rt
+    from rocket_tpu.models.objectives import lm_cross_entropy
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    rng = np.random.default_rng(0)
+    data = {"tokens": rng.integers(0, 64, size=(64, 16)).astype(np.int32)}
+    cfg_kw = dict(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=32,
+        attention="dot",
+    )
+
+    def tree(epochs, resume=None):
+        model = rt.Module(
+            TransformerLM(TransformerConfig(**cfg_kw)),
+            capsules=[rt.Loss(lm_cross_entropy(), name="lm"),
+                      rt.Optimizer(learning_rate=1e-2)],
+            fuse_accumulation=True,
+        )
+        looper = rt.Looper(
+            capsules=[
+                rt.Dataset(rt.ArraySource(data), batch_size=8, shuffle=True,
+                           seed=5),
+                model,
+                # save_every=3 deliberately MISALIGNED with accum=2: the
+                # snapshot at iter 2 lands mid-window
+                rt.Checkpointer(save_every=3),
+            ],
+            progress=False,
+        )
+        launcher = rt.Launcher(
+            capsules=[looper], tag="fw", num_epochs=epochs,
+            project_root=str(tmp_path),
+            gradient_accumulation_steps=2,
+        )
+        if resume:
+            launcher.resume(resume)
+        return launcher, model
+
+    launcher, model = tree(epochs=1)
+    launcher.launch()
+    # 8 launches -> 4 effective steps; snapshots at iters 2 and 5
+    assert model.step == 4
+    ckpts = sorted((tmp_path / "fw" / "v0" / "weights").iterdir())
+    assert [c.name for c in ckpts] == ["000002", "000005"]
+
+    # resume from the MID-WINDOW snapshot (iter 2 = 1 effective step + 1
+    # buffered launch that the snapshot could not capture)
+    launcher2, model2 = tree(epochs=2, resume=str(ckpts[0]))
+    launcher2.launch()
+    # the partial window restarted: remaining launches of epoch 0 form
+    # fresh windows; training completed both epochs with a sane count
+    assert model2.step > model.step
+    assert model2._window_buffer == []  # nothing stranded
