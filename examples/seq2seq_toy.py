@@ -74,8 +74,9 @@ def main():
         vocab_size=VOCAB, hidden=128, n_encoder_layers=2,
         n_decoder_layers=2, n_heads=4, max_seq=SEQ + 1, attention="dot",
     )
+    model_def = EncoderDecoder(cfg)
     model = rt.Module(
-        EncoderDecoder(cfg),
+        model_def,
         capsules=[
             rt.Loss(lm_cross_entropy(tokens_key="targets"), name="rev"),
             rt.Optimizer(learning_rate=3e-3),
@@ -104,6 +105,29 @@ def main():
     launcher.launch()
     assert metric.last is not None
     print("final:", metric.last)
+
+    # decode a few held-out examples greedily AND with beam search
+    import jax.numpy as jnp
+
+    from rocket_tpu.models.generate import (
+        beam_search_seq2seq, generate_seq2seq)
+
+    test = make_split(4, 2)
+    inputs = jnp.asarray(test["inputs"][:4])
+    params = {"params": model.state.params}
+    greedy = generate_seq2seq(
+        model_def, params, inputs, max_new_tokens=inputs.shape[1], bos_id=BOS
+    )
+    beam, scores = beam_search_seq2seq(
+        model_def, params, inputs, max_new_tokens=inputs.shape[1],
+        bos_id=BOS, eos_id=BOS, beam_size=4,  # ids 2.. are data; 1 never emits
+    )
+    for i in range(inputs.shape[0]):
+        print(f"in : {list(map(int, inputs[i]))}")
+        print(f"rev: {list(map(int, test['targets'][i][1:]))}")
+        print(f"gr : {list(map(int, greedy[i][1:]))}")
+        print(f"bm : {list(map(int, beam[i][1:]))} "
+              f"(score {float(scores[i]):.2f})")
 
 
 if __name__ == "__main__":
