@@ -107,6 +107,54 @@ def main() -> None:
     launcher2.launch()
     assert int(module2.step) == steps, (int(module2.step), steps)
 
+    # 5) round-4 features across REAL processes: multi-optimizer param
+    #    groups + the fused accumulation window in one jitted step
+    runtime = rt.Runtime(gradient_accumulation_steps=2)
+
+    def embed_filter(path, leaf):
+        return any(
+            "embed" in str(getattr(part, "key", "")).lower()
+            for part in path
+        )
+
+    module3 = rt.Module(
+        TransformerLM(cfg),
+        capsules=[
+            rt.Loss(lm_cross_entropy(), name="lm"),
+            rt.Optimizer(learning_rate=0.0, params_filter=embed_filter,
+                         tag="lr_embed"),
+            rt.Optimizer(learning_rate=1e-2,
+                         params_filter=lambda p, x: not embed_filter(p, x),
+                         tag="lr_rest"),
+        ],
+        fuse_accumulation=True,
+    )
+    module3.bind(runtime)
+    module3.setup()
+    loader = rt.DataLoader(
+        rt.ArraySource(data), batch_size=8,
+        sharding=runtime.batch_sharding(ndim=2), prefetch=0,
+    )
+    attrs = rt.Attributes(
+        looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
+    )
+    for batch in loader.iterate():
+        attrs.batch = batch
+        module3.launch(attrs)
+    # 4 launches / window 2 -> 2 effective steps; frozen embed group
+    assert int(module3.state.step) == 2, int(module3.state.step)
+    import flax.linen as flax_nn
+
+    flat = flax_nn.meta.unbox(
+        multihost.to_host_global(module3.state.params)
+    )
+    multihost.assert_equal(
+        float(np.asarray(flat["embed"]["embedding"]).sum()),
+        "fused-window params diverged across hosts",
+    )
+    assert float(attrs.looper.state["lr_rest"]) == 1e-2
+    module3.destroy()
+
     multihost.sync_global_devices("mp-test-done")
     print(f"WORKER-OK {pid}", flush=True)
     multihost.shutdown()
