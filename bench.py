@@ -228,7 +228,7 @@ def run_config(name, module, batch_np, samples_per_step, n_steps, warmup,
 def bench_resnet50(n_steps, warmup):
     from rocket_tpu.models.resnet import resnet50
 
-    B = 256
+    B = int(os.environ.get("BENCH_RESNET_BATCH", 256))
     module = rt.Module(
         resnet50(num_classes=10, small_images=True),
         capsules=[
@@ -256,7 +256,7 @@ def bench_resnet50(n_steps, warmup):
 def bench_vit_b16(n_steps, warmup):
     from rocket_tpu.models.vit import ViT, ViTConfig
 
-    B = 64
+    B = int(os.environ.get("BENCH_VIT_BATCH", 64))
     module = rt.Module(
         ViT(ViTConfig.b16()),
         capsules=[
