@@ -286,14 +286,16 @@ def bench_vit_b16(n_steps, warmup):
 # tiles the MXU cleanly (same trick as the public nanoGPT recipe); the
 # extra logits are never targeted by data (ids < 50257) and their FLOPs
 # ARE executed, so the analytical formula counts the padded size.
-# Defaults = the best MEASURED configuration (docs/performance.md
-# ablations: blocks 512/1024 at bs8 = 0.426 MFU).  Stronger combinations
-# (bs16 × the same blocks, + fused_qkv/fused_ce) are plausible but
-# unmeasured; re-pin only after --sweep confirms them on a chip.
-GPT2_TUNE = dict(batch=8, seq=1024, block_q=512, block_k=1024,
+# Defaults = the best MEASURED configuration: the round-4 on-chip sweep
+# (experiments/bench_runs.jsonl, 2026-07-31) measured every combination
+# point and picked bs16 x blocks 512/1024 = 0.4587 MFU / 119.6k tok/s.
+# The fused_qkv / fused_ce variants all measured SLOWER on the v5e chip
+# (0.40-0.42) and stay off; scan_layers compiled under the auto-guard
+# but ran at 0.328.
+GPT2_TUNE = dict(batch=16, seq=1024, block_q=512, block_k=1024,
                  vocab=50304, scan_layers=False, remat=False,
                  fused_qkv=False, fused_ce=False, ce_chunk=1024,
-                 remat_policy="nothing")
+                 remat_policy="nothing", attention="auto")
 
 
 _SCAN_CHECK_CACHE: dict = {}
@@ -377,7 +379,7 @@ def resolve_scan_guard(t: dict, check=None) -> tuple:
         remat_policy=t["remat_policy"], fused_qkv=t["fused_qkv"],
         fused_ce=t["fused_ce"], fused_ce_chunk=t["ce_chunk"],
         vocab_size=t["vocab"],
-        attention="auto",
+        attention=t.get("attention", "auto"),
         attention_block_q=t["block_q"],
         attention_block_k=t["block_k"],
     )
@@ -401,7 +403,7 @@ def bench_gpt2(n_steps, warmup, tune=None):
         print(json.dumps({"warning": scan_fallback}), flush=True)
     batch, seq = t["batch"], t["seq"]
     cfg = TransformerConfig.gpt2_124m(
-        attention="auto",
+        attention=t.get("attention", "auto"),
         vocab_size=t["vocab"],
         attention_block_q=t["block_q"],
         attention_block_k=t["block_k"],
@@ -468,6 +470,13 @@ def sweep_gpt2(n_steps, warmup):
                  "batch": 16, "block_q": 512, "block_k": 1024})
     grid.append({"fused_qkv": True, "fused_ce": True,
                  "batch": 32, "block_q": 512, "block_k": 1024})
+    # attention-impl ablation: plain XLA dot attention materializes the
+    # [B,H,S,S] logits but lets XLA fuse/tile freely — at moderate seq it
+    # can beat a hand-tiled pallas kernel on the MXU.
+    grid.append({"attention": "dot"})
+    grid.append({"attention": "dot", "batch": 8})
+    grid.append({"batch": 12})          # refine around the bs16 optimum
+    grid.append({"batch": 24})
     grid.append({"scan_layers": True})  # scan ablation
     grid.append({"remat": True})        # remat ablation
     grid.append({"remat": True, "remat_policy": "dots"})
