@@ -62,6 +62,21 @@ def test_resolve_scan_guard_keeps_healthy_scan(bench):
     assert out["scan_layers"] is True and note is None
 
 
+def test_resolve_scan_guard_threads_attention_impl(bench):
+    # The guard must AOT-check the SAME attention implementation the
+    # bench will run: a dot-attention scan config checked as flash (or
+    # vice versa) validates a different executable than the one timed.
+    seen = {}
+
+    def check(structural, batch, seq):
+        seen.update(structural)
+        return True, "ok"
+
+    t = dict(bench.GPT2_TUNE, scan_layers=True, attention="dot")
+    bench.resolve_scan_guard(t, check=check)
+    assert seen["attention"] == "dot"
+
+
 def test_resolve_scan_guard_noop_without_scan(bench):
     calls = []
     t = dict(bench.GPT2_TUNE)  # scan_layers False by default
