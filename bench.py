@@ -116,21 +116,44 @@ from rocket_tpu.models.objectives import cross_entropy, lm_cross_entropy  # noqa
 from rocket_tpu.models.transformer import TransformerConfig, TransformerLM  # noqa: E402
 
 
+def _device_peak(table: dict, default: float) -> float:
+    """Look up the local accelerator in a {kind-substring: peak} table.
+
+    Ordering matters: more specific kinds ('v5 lite', 'v5p') must precede
+    bare 'v5' — dicts preserve insertion order."""
+    kind = jax.devices()[0].device_kind.lower()
+    for key, val in table.items():
+        if key in kind:
+            return val
+    return default
+
+
 def peak_flops_per_chip() -> float:
     """bf16 peak for the local accelerator (fallback: v5e)."""
-    kind = jax.devices()[0].device_kind.lower()
-    table = {
+    return _device_peak({
         "v5 lite": 197e12, "v5e": 197e12,
         "v4": 275e12,
         "v5p": 459e12, "v5": 459e12,
         "v6 lite": 918e12, "v6e": 918e12,
         "v3": 123e12,
         "v2": 45e12,
-    }
-    for key, val in table.items():
-        if key in kind:
-            return val
-    return 197e12
+    }, 197e12)
+
+
+def peak_hbm_bytes_per_chip() -> float:
+    """HBM bandwidth peak for the local accelerator (fallback: v5e).
+
+    Decode is bandwidth-bound (every emitted token re-reads the weights),
+    so the decode bench reports MBU — model-bandwidth utilization —
+    against this, the serving-world analogue of MFU."""
+    return _device_peak({
+        "v5 lite": 819e9, "v5e": 819e9,
+        "v4": 1228e9,
+        "v5p": 2765e9, "v5": 2765e9,
+        "v6 lite": 1640e9, "v6e": 1640e9,
+        "v3": 900e9,
+        "v2": 700e9,
+    }, 819e9)
 
 
 def gpt2_step_flops(cfg: TransformerConfig, batch: int, seq: int) -> float:
@@ -531,10 +554,86 @@ def sweep_gpt2(n_steps, warmup):
         _persist_record(line)
 
 
+def bench_gpt2_decode(n_steps, warmup):
+    """KV-cache decode throughput (the serving-side number).
+
+    GPT-2 124M, prompt 128 -> 128 new tokens per call, greedy-ish
+    sampling at temperature 1.  Decode is HBM-bandwidth-bound — each
+    emitted token re-reads the bf16 weights plus the live KV cache — so
+    the record carries MBU (achieved bytes/s over peak) alongside raw
+    tokens/sec.  ``max_seq`` is sized to prompt+new so the static cache
+    isn't padded with dead positions the kernels would still scan.
+    """
+    from rocket_tpu.models.generate import generate
+
+    B = int(os.environ.get("BENCH_DECODE_BATCH", 8))
+    PROMPT, NEW = 128, 128
+    cfg = TransformerConfig.gpt2_124m(vocab_size=50304, max_seq=PROMPT + NEW)
+    model = TransformerLM(cfg)
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 50257, size=(B, PROMPT)), jnp.int32)
+    variables = jax.jit(model.init)(
+        jax.random.PRNGKey(0), {"tokens": prompt}
+    )
+    params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if isinstance(a, jax.Array) and jnp.issubdtype(a.dtype, jnp.floating)
+        else a,
+        variables["params"],
+    )
+
+    def run(params, prompt, key):
+        return generate(model, params, prompt, NEW, rng=key, temperature=1.0)
+
+    run = jax.jit(run)
+    key = jax.random.PRNGKey(1)
+    out = None
+    for _ in range(max(1, warmup)):
+        out = run(params, prompt, key)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for i in range(n_steps):
+        out = run(params, prompt, jax.random.fold_in(key, i))
+        jax.block_until_ready(out)  # each call is an independent request
+    elapsed = time.perf_counter() - t0
+
+    per_call = elapsed / n_steps
+    tok_per_s = B * NEW / per_call
+    param_bytes = sum(
+        a.size * a.dtype.itemsize for a in jax.tree_util.tree_leaves(params)
+    )
+    # per decode step: weights once + ~half the KV cache (growing frontier)
+    from rocket_tpu.models.generate import decode_cache_shapes
+
+    kv_bytes = sum(
+        a.size * a.dtype.itemsize
+        for a in jax.tree_util.tree_leaves(
+            decode_cache_shapes(model, params, prompt)
+        )
+    )
+    bytes_per_call = NEW * (param_bytes + kv_bytes / 2)
+    mbu = bytes_per_call / per_call / peak_hbm_bytes_per_chip()
+    return {
+        "config": "gpt2-decode",
+        "metric": f"gpt2-124m KV-cache decode (1 chip, bf16, bs{B}, "
+                  f"{PROMPT}+{NEW} tokens)",
+        "value": round(tok_per_s, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": None,
+        "per_call_ms": round(per_call * 1e3, 2),
+        "mbu": round(mbu, 4),
+        "device": jax.devices()[0].device_kind,
+        "baseline_note": "reference has no generation path at all; MBU = "
+                         "achieved HBM bytes/s over peak (decode is "
+                         "bandwidth-bound)",
+    }
+
+
 BENCHES = {
     "resnet50": bench_resnet50,
     "vit": bench_vit_b16,
     "gpt2": bench_gpt2,
+    "decode": bench_gpt2_decode,
 }
 
 
@@ -572,15 +671,21 @@ def main() -> None:
             print(json.dumps(bench_gpt2(args.steps, args.warmup)), flush=True)
         return
     units = {"resnet50": "samples/sec/chip", "vit": "samples/sec/chip",
-             "gpt2": "tokens/sec/chip"}
-    names = [args.only] if args.only else ["resnet50", "vit", "gpt2"]
+             "gpt2": "tokens/sec/chip", "decode": "tokens/sec/chip"}
+    # gpt2 stays LAST: the driver reads the final stdout line as the
+    # headline record
+    names = [args.only] if args.only else ["resnet50", "vit", "decode",
+                                           "gpt2"]
+    labels = {"decode": "KV-cache decode"}  # default: train throughput
     for name in names:
         try:
             record = BENCHES[name](args.steps, args.warmup)
         except Exception as exc:
             record = {
                 "config": name,
-                "metric": f"{name} train throughput (1 chip, bf16)",
+                "metric": f"{name} "
+                          f"{labels.get(name, 'train throughput')} "
+                          f"(1 chip, bf16)",
                 "value": None,
                 "unit": units[name],
                 "vs_baseline": None,

@@ -60,6 +60,24 @@ def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def decode_cache_shapes(model: Any, params: Any, prompt: jax.Array):
+    """Static KV-cache shapes/dtypes for decoding ``prompt`` with ``params``.
+
+    Shapes derive from the CALLER's params (not a fresh f32 init): the
+    cache variables take their dtype from the computed k/v, so decoding
+    with bf16-cast weights needs a bf16 cache — a fresh init would make
+    an f32 one and ``dynamic_update_slice`` rejects the dtype mismatch.
+    eval_shape costs nothing at runtime.  Also the bytes model for the
+    decode bench's MBU (``bench.bench_gpt2_decode``)."""
+    return jax.eval_shape(
+        lambda p: model.apply(
+            {"params": p}, {"tokens": prompt}, decode=True,
+            mutable=["cache"],
+        )[1]["cache"],
+        params,
+    )
+
+
 def generate(
     model: Any,
     params: Any,
@@ -90,12 +108,7 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    # cache shapes are static; eval_shape costs nothing at runtime
-    cache_shapes = jax.eval_shape(
-        lambda: model.init(
-            jax.random.PRNGKey(0), {"tokens": prompt}, decode=True
-        )["cache"]
-    )
+    cache_shapes = decode_cache_shapes(model, params, prompt)
     cache = jax.tree_util.tree_map(
         lambda s: jnp.zeros(s.shape, s.dtype), cache_shapes
     )

@@ -534,6 +534,39 @@ def test_generate_cached_matches_full_forward(devices, style):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(seq))
 
 
+def test_generate_with_bf16_cast_params(devices):
+    """Serving casts weights to bf16 before decoding; the KV cache must
+    follow the params' dtype (regression: generate derived cache shapes
+    from a fresh f32 init, so bf16 k/v hit an f32 cache and
+    dynamic_update_slice rejected the dtype mismatch)."""
+    import jax.numpy as jnp
+
+    from rocket_tpu.models.generate import generate
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=48,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+    )
+    model = TransformerLM(cfg)
+    B, P, NEW = 2, 8, 6
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(B, P)), jnp.int32
+    )
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+    params = jax.tree_util.tree_map(
+        lambda a: a.astype(jnp.bfloat16)
+        if jnp.issubdtype(a.dtype, jnp.floating) else a,
+        params,
+    )
+    got = generate(model, params, prompt, max_new_tokens=NEW, temperature=0.0)
+    assert got.shape == (B, P + NEW)
+    assert jnp.all((got >= 0) & (got < 64))
+
+
 def test_generate_sampling_shapes_and_jit(devices):
     """Temperature/top-k sampling path runs under jit and respects the
     vocab bound."""
