@@ -567,12 +567,23 @@ def bench_gpt2_decode(n_steps, warmup):
     from rocket_tpu.models.generate import generate
 
     B = int(os.environ.get("BENCH_DECODE_BATCH", 8))
+    int8 = bool(int(os.environ.get("BENCH_DECODE_INT8", "0")))
     PROMPT, NEW = 128, 128
-    cfg = TransformerConfig.gpt2_124m(vocab_size=50304, max_seq=PROMPT + NEW)
+    cfg = TransformerConfig.gpt2_124m(vocab_size=50304, max_seq=PROMPT + NEW,
+                                      weights_int8=int8)
     model = TransformerLM(cfg)
     rng = np.random.default_rng(0)
     prompt = jnp.asarray(rng.integers(0, 50257, size=(B, PROMPT)), jnp.int32)
-    variables = jax.jit(model.init)(
+    init_model = model
+    if int8:
+        # init trained-shaped f32 weights, then rewrite into the int8
+        # layout — the same flow a user quantizing a checkpoint follows
+        init_model = TransformerLM(
+            TransformerConfig.gpt2_124m(
+                vocab_size=50304, max_seq=PROMPT + NEW
+            )
+        )
+    variables = jax.jit(init_model.init)(
         jax.random.PRNGKey(0), {"tokens": prompt}
     )
     params = jax.tree_util.tree_map(
@@ -581,6 +592,10 @@ def bench_gpt2_decode(n_steps, warmup):
         else a,
         variables["params"],
     )
+    if int8:
+        from rocket_tpu.ops.quant import quantize_params
+
+        params = jax.jit(quantize_params)(params)
 
     def run(params, prompt, key):
         return generate(model, params, prompt, NEW, rng=key, temperature=1.0)
@@ -613,9 +628,10 @@ def bench_gpt2_decode(n_steps, warmup):
     )
     bytes_per_call = NEW * (param_bytes + kv_bytes / 2)
     mbu = bytes_per_call / per_call / peak_hbm_bytes_per_chip()
+    wdt = "int8 weights" if int8 else "bf16"
     return {
-        "config": "gpt2-decode",
-        "metric": f"gpt2-124m KV-cache decode (1 chip, bf16, bs{B}, "
+        "config": "gpt2-decode-int8" if int8 else "gpt2-decode",
+        "metric": f"gpt2-124m KV-cache decode (1 chip, {wdt}, bs{B}, "
                   f"{PROMPT}+{NEW} tokens)",
         "value": round(tok_per_s, 1),
         "unit": "tokens/sec/chip",
@@ -677,7 +693,9 @@ def main() -> None:
     names = [args.only] if args.only else ["resnet50", "vit", "decode",
                                            "gpt2"]
     labels = {"decode": "KV-cache decode"}  # default: train throughput
+    decode_int8 = bool(int(os.environ.get("BENCH_DECODE_INT8", "0")))
     for name in names:
+        wdt = "int8 weights" if name == "decode" and decode_int8 else "bf16"
         try:
             record = BENCHES[name](args.steps, args.warmup)
         except Exception as exc:
@@ -685,7 +703,7 @@ def main() -> None:
                 "config": name,
                 "metric": f"{name} "
                           f"{labels.get(name, 'train throughput')} "
-                          f"(1 chip, bf16)",
+                          f"(1 chip, {wdt})",
                 "value": None,
                 "unit": units[name],
                 "vs_baseline": None,

@@ -69,16 +69,38 @@ class PDense(nn.Module):
     kernel_init: Callable = nn.initializers.lecun_normal()
     lora_rank: int = 0
     lora_alpha: float = 16.0
+    # Inference-only W8A16: the kernel lives as int8 + per-output-channel
+    # scale (ops.quant.quantize_params produces the layout from trained
+    # weights) and decode-shaped matmuls read int8 HBM via the pallas
+    # kernel — the bandwidth that bounds KV-cache decode is halved.
+    weights_int8: bool = False
 
     @nn.compact
     def __call__(self, x):
         in_dim = x.shape[-1]
-        kernel = self.param(
-            "kernel",
-            _init(self.kernel_init, *self.logical_axes),
-            (in_dim, self.features),
-        )
-        y = jnp.einsum("...d,df->...f", x, kernel.astype(x.dtype))
+        if self.weights_int8:
+            from rocket_tpu.ops.quant import int8_matmul
+
+            kernel_q = self.param(
+                "kernel_q",
+                _init(nn.initializers.zeros_init(), *self.logical_axes),
+                (in_dim, self.features),
+                jnp.int8,
+            )
+            kernel_scale = self.param(
+                "kernel_scale",
+                _init(nn.initializers.ones_init(), self.logical_axes[-1]),
+                (self.features,),
+                jnp.float32,
+            )
+            y = int8_matmul(x, kernel_q, kernel_scale)
+        else:
+            kernel = self.param(
+                "kernel",
+                _init(self.kernel_init, *self.logical_axes),
+                (in_dim, self.features),
+            )
+            y = jnp.einsum("...d,df->...f", x, kernel.astype(x.dtype))
         if self.lora_rank > 0:
             a = self.param(
                 "lora_a",
@@ -111,8 +133,26 @@ class Embed(nn.Module):
     vocab_size: int
     features: int
     dtype: Any = None  # None = the table's own dtype (the policy casts it)
+    # Inference-only: int8 table + per-vocab-row scale. The row scale
+    # serves both directions of tying — rows are the output channels of
+    # ``attend`` (the LM head) and the units of the token gather.
+    weights_int8: bool = False
 
     def setup(self):
+        if self.weights_int8:
+            self.embedding_q = self.param(
+                "embedding_q",
+                _init(nn.initializers.zeros_init(), "vocab", "embed"),
+                (self.vocab_size, self.features),
+                jnp.int8,
+            )
+            self.embedding_scale = self.param(
+                "embedding_scale",
+                _init(nn.initializers.ones_init(), "vocab"),
+                (self.vocab_size,),
+                jnp.float32,
+            )
+            return
         self.embedding = self.param(
             "embedding",
             _init(nn.initializers.normal(0.02), "vocab", "embed"),
@@ -120,6 +160,13 @@ class Embed(nn.Module):
         )
 
     def __call__(self, tokens):
+        if self.weights_int8:
+            # Gathering B*S int8 rows + scales is negligible traffic; the
+            # dequant happens on the gathered slice, never the full table.
+            dt = self.dtype if self.dtype is not None else jnp.bfloat16
+            rows = jnp.asarray(self.embedding_q)[tokens].astype(dt)
+            s = jnp.asarray(self.embedding_scale)[tokens].astype(dt)
+            return rows * s[..., None]
         # The precision policy casts params to the compute dtype before
         # apply, so the table's dtype IS the compute dtype — pinning f32
         # here would silently upcast the whole residual stream (every
@@ -155,6 +202,13 @@ class Embed(nn.Module):
         return size > 1
 
     def attend(self, x):
+        if self.weights_int8:
+            from rocket_tpu.ops.quant import int8_matmul
+
+            # nk_layout: the table's natural [vocab, embed] IS [N, K]
+            return int8_matmul(
+                x, self.embedding_q, self.embedding_scale, nk_layout=True
+            )
         return jnp.einsum(
             "...d,vd->...v", x, jnp.asarray(self.embedding, x.dtype)
         )

@@ -74,6 +74,12 @@ class TransformerConfig:
     # matmuls — at GPT-2 width the MXU prefers the single wider matmul.
     # Changes the param tree (attn/qkv vs attn/{q,k,v}), so it is opt-in.
     fused_qkv: bool = False
+    # Inference-only W8A16 (ops.quant): kernels + tied embedding live as
+    # int8 with per-channel scales; decode-shaped matmuls read int8 HBM
+    # via the pallas kernel.  Load weights with quantize_params; training
+    # a weights_int8 model is rejected by the Module (int8 leaves are not
+    # trainable).
+    weights_int8: bool = False
     # Logits-free LM loss: emit per-token NLL (``batch['token_nll']``,
     # consumed by objectives.lm_cross_entropy) straight from the tied
     # embedding table via ops.fused_ce — the [B*S, vocab] logits tensor
@@ -119,6 +125,18 @@ class TransformerConfig:
             raise ValueError(
                 "pipeline_microbatches and pipeline_microbatch_size are "
                 "mutually exclusive"
+            )
+        if self.weights_int8 and self.fused_ce:
+            raise ValueError(
+                "weights_int8 is an inference-only layout; fused_ce is a "
+                "training loss path reading the raw embedding table — "
+                "they cannot combine"
+            )
+        if self.weights_int8 and self.scan_layers:
+            raise ValueError(
+                "weights_int8 requires the unrolled layer layout "
+                "(scan_layers=False): scan stacks kernels to rank 3, "
+                "which quantize_params rejects"
             )
 
     @property
@@ -240,6 +258,7 @@ class Attention(nn.Module):
             use_bias=cfg.use_bias,
             lora_rank=cfg.lora_rank,
             lora_alpha=cfg.lora_alpha,
+            weights_int8=cfg.weights_int8,
             name=name,
         )
         if cfg.fused_qkv:
@@ -279,6 +298,7 @@ class Attention(nn.Module):
             use_bias=cfg.use_bias,
             lora_rank=cfg.lora_rank,
             lora_alpha=cfg.lora_alpha,
+            weights_int8=cfg.weights_int8,
             name="o",
         )(out)
         if cfg.dropout and train:
@@ -324,8 +344,10 @@ class MLP(nn.Module):
         up_axes = ("embed", "mlp")
         down_axes = ("mlp", "embed")
         if cfg.mlp == "swiglu":
-            gate = PDense(cfg.mlp_dim, logical_axes=up_axes, name="gate")(x)
-            up = PDense(cfg.mlp_dim, logical_axes=up_axes, name="up")(x)
+            gate = PDense(cfg.mlp_dim, logical_axes=up_axes,
+                          weights_int8=cfg.weights_int8, name="gate")(x)
+            up = PDense(cfg.mlp_dim, logical_axes=up_axes,
+                        weights_int8=cfg.weights_int8, name="up")(x)
             h = nn.silu(gate) * up
         else:
             h = nn.gelu(
@@ -333,6 +355,7 @@ class MLP(nn.Module):
                     cfg.mlp_dim,
                     logical_axes=up_axes,
                     use_bias=cfg.use_bias,
+                    weights_int8=cfg.weights_int8,
                     name="up",
                 )(x)
             )
@@ -341,6 +364,7 @@ class MLP(nn.Module):
             cfg.hidden,
             logical_axes=down_axes,
             use_bias=cfg.use_bias,
+            weights_int8=cfg.weights_int8,
             name="down",
         )(h)
         if cfg.dropout and train:
@@ -508,7 +532,8 @@ class TransformerLM(nn.Module):
             positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
         segment_ids = batch.get("segment_ids") if hasattr(batch, "get") else None
 
-        embed = Embed(cfg.vocab_size, cfg.hidden, name="embed")
+        embed = Embed(cfg.vocab_size, cfg.hidden,
+                      weights_int8=cfg.weights_int8, name="embed")
         x = embed(tokens)
         if cfg.positions == "learned":
             pos_table = self.param(
@@ -590,7 +615,8 @@ class TransformerLM(nn.Module):
                 logits = embed.attend(x)
             else:
                 logits = PDense(
-                    cfg.vocab_size, logical_axes=("embed", "vocab"), name="head"
+                    cfg.vocab_size, logical_axes=("embed", "vocab"),
+                    weights_int8=cfg.weights_int8, name="head"
                 )(x)
             logits = constrain(logits, "batch", "sequence", "vocab")
             out[self.logits_key] = logits

@@ -1,12 +1,16 @@
 from rocket_tpu.ops.attention import attend, dot_attention
 from rocket_tpu.ops.flash import flash_attention
 from rocket_tpu.ops.fused_ce import linear_cross_entropy
+from rocket_tpu.ops.quant import int8_matmul, quantize_int8, quantize_params
 from rocket_tpu.ops.ring import ring_attention
 
 __all__ = [
     "attend",
     "dot_attention",
     "flash_attention",
+    "int8_matmul",
     "linear_cross_entropy",
+    "quantize_int8",
+    "quantize_params",
     "ring_attention",
 ]

@@ -1,0 +1,67 @@
+"""Worker for the 16k-token ring-attention training-step smoke test.
+
+Runs in a FRESH interpreter (tests/test_ops.py spawns it): inside a long
+pytest session the accumulated backend state (hundreds of compiled
+executables and their thread pools) makes this largest-in-the-suite
+program abort inside XLA:CPU — in a clean process it passes in seconds.
+Same isolation pattern as multiproc_worker.py.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def main() -> None:
+    import rocket_tpu as rt
+    from rocket_tpu.models.objectives import lm_cross_entropy
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+    from rocket_tpu.parallel.mesh import MeshSpec
+
+    S = 16_384
+    runtime = rt.Runtime(mesh=MeshSpec(seq=8), mixed_precision="bf16")
+    cfg = TransformerConfig(
+        vocab_size=128, hidden=64, n_layers=1, n_heads=4,
+        max_seq=S, attention="ring",
+    )
+    mod = rt.Module(
+        TransformerLM(cfg),
+        capsules=[rt.Loss(lm_cross_entropy(), name="lm"),
+                  rt.Optimizer(learning_rate=1e-3)],
+    )
+    mod.bind(runtime)
+    mod.setup()
+    rng = np.random.default_rng(0)
+    batch = jax.device_put(
+        {"tokens": jnp.asarray(rng.integers(0, 128, (1, S)), jnp.int32)},
+        runtime.batch_sharding(ndim=2, seq_dim=1),
+    )
+    attrs = rt.Attributes(
+        looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
+    )
+    attrs.batch = batch
+    mod.launch(attrs)
+    loss = float(attrs.step_logs["lm"])
+    assert np.isfinite(loss) and 3.0 < loss < 7.0, loss  # ~ln(128)=4.85
+    assert int(mod.state.step) == 1
+    mod.destroy()
+    print("long-context-ok", loss)
+
+
+if __name__ == "__main__":
+    main()

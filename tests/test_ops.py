@@ -2,6 +2,7 @@
 parallel) against the dot-attention oracle, values AND gradients."""
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -326,35 +327,19 @@ def test_long_context_16k_ring_training_step(devices):
     """Long-context smoke (SURVEY first-class requirement): one real
     train step of a tiny TransformerLM at 16,384 tokens with ring
     attention over seq=8 — each device holds a 2k shard; the full
-    [S, S] score matrix (1GB+ in f32) never exists anywhere."""
-    import rocket_tpu as rt
-    from rocket_tpu.models.objectives import lm_cross_entropy
-    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+    [S, S] score matrix (1GB+ in f32) never exists anywhere.
 
-    S = 16_384
-    runtime = rt.Runtime(mesh=MeshSpec(seq=8), mixed_precision="bf16")
-    cfg = TransformerConfig(
-        vocab_size=128, hidden=64, n_layers=1, n_heads=4,
-        max_seq=S, attention="ring",
+    Runs in a FRESH subprocess (tests/long_context_worker.py): inside a
+    long pytest session the accumulated XLA:CPU state makes this
+    largest-in-the-suite program abort (SIGABRT at result fetch) even
+    with >100GB free — in a clean interpreter it passes in seconds."""
+    import subprocess
+    import sys
+
+    worker = os.path.join(os.path.dirname(__file__), "long_context_worker.py")
+    proc = subprocess.run(
+        [sys.executable, worker], timeout=600.0,
+        capture_output=True, text=True,
     )
-    mod = rt.Module(
-        TransformerLM(cfg),
-        capsules=[rt.Loss(lm_cross_entropy(), name="lm"),
-                  rt.Optimizer(learning_rate=1e-3)],
-    )
-    mod.bind(runtime)
-    mod.setup()
-    rng = np.random.default_rng(0)
-    batch = jax.device_put(
-        {"tokens": jnp.asarray(rng.integers(0, 128, (1, S)), jnp.int32)},
-        runtime.batch_sharding(ndim=2, seq_dim=1),
-    )
-    attrs = rt.Attributes(
-        looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
-    )
-    attrs.batch = batch
-    mod.launch(attrs)
-    loss = float(attrs.step_logs["lm"])
-    assert np.isfinite(loss) and 3.0 < loss < 7.0, loss  # ~ln(128)=4.85
-    assert int(mod.state.step) == 1
-    mod.destroy()
+    assert proc.returncode == 0, (proc.stdout or "") + (proc.stderr or "")
+    assert "long-context-ok" in proc.stdout

@@ -1,0 +1,193 @@
+"""Int8 weight-only quantization (ops.quant): kernel correctness on the
+interpret backend, params-tree rewriting, and end-to-end decode parity.
+
+The reference has no quantization or generation path at all; this is a
+TPU-native serving addition (W8A16: int8 HBM reads for decode-shaped
+matmuls, dequant in VMEM)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocket_tpu.ops.quant import (
+    dequantize_int8,
+    int8_matmul,
+    quantize_int8,
+    quantize_params,
+)
+
+
+def test_quantize_roundtrip_error_bound(devices):
+    w = jax.random.normal(jax.random.PRNGKey(0), (128, 256)) * 3.0
+    q, s = quantize_int8(w, axis=0)
+    assert q.dtype == jnp.int8 and s.shape == (256,)
+    back = dequantize_int8(q, s, axis=0, dtype=jnp.float32)
+    # symmetric rounding: per-element error <= half a quantization step
+    err = np.abs(np.asarray(w - back))
+    bound = np.broadcast_to(np.asarray(s)[None, :] * 0.5 + 1e-7, err.shape)
+    np.testing.assert_array_less(err, bound)
+
+
+def test_quantize_zero_channel(devices):
+    w = jnp.zeros((64, 128))
+    q, s = quantize_int8(w, axis=0)
+    assert np.all(np.asarray(q) == 0)
+    back = dequantize_int8(q, s, axis=0, dtype=jnp.float32)
+    assert np.all(np.asarray(back) == 0)
+
+
+@pytest.mark.parametrize("m", [1, 8])
+@pytest.mark.parametrize("nk_layout", [False, True])
+def test_int8_matmul_kernel_matches_dequant(devices, m, nk_layout):
+    """The pallas kernel path (decode-shaped M) must equal the dequant
+    einsum bit-for-bit-ish; N=320 is deliberately not a multiple of the
+    256 block to exercise the padding."""
+    K, N = 128, 320
+    key = jax.random.PRNGKey(1)
+    x = jax.random.normal(key, (m, K), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(2), (K, N))
+    q, s = quantize_int8(w, axis=0)
+    if nk_layout:
+        q_in = q.T  # [N, K] — the tied-embedding layout
+    else:
+        q_in = q
+    got = int8_matmul(x, q_in, s, nk_layout=nk_layout, block_n=256)
+    # f32 oracle: the kernel accumulates f32 over exact int8 weights and
+    # applies the scale AFTER the dot, so it sits closer to this than a
+    # bf16-dequantized-weights matmul does
+    want = x.astype(jnp.float32) @ (
+        q.astype(jnp.float32) * s[None, :]
+    )
+    assert got.shape == (m, N)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=1.5e-2, atol=1.5e-2,
+    )
+
+
+def test_int8_matmul_large_m_falls_back(devices):
+    """Prefill/training shapes (M > KERNEL_MAX_ROWS) take the einsum path
+    and still match."""
+    K, N = 128, 256
+    x = jax.random.normal(jax.random.PRNGKey(3), (2, 128, K), jnp.bfloat16)
+    w = jax.random.normal(jax.random.PRNGKey(4), (K, N))
+    q, s = quantize_int8(w, axis=0)
+    got = int8_matmul(x, q, s)
+    want = jnp.einsum(
+        "bsk,kn->bsn", x, dequantize_int8(q, s, axis=0, dtype=jnp.bfloat16)
+    )
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        rtol=2e-2, atol=2e-2,
+    )
+
+
+def _tiny_cfg(**kw):
+    from rocket_tpu.models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=48,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot", **kw,
+    )
+
+
+def test_quantize_params_matches_int8_model_structure(devices):
+    """quantize_params must produce exactly the tree the weights_int8
+    model expects — same paths, shapes, and dtypes as its own init."""
+    import flax.linen as nn
+
+    from rocket_tpu.models.transformer import TransformerLM
+
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    f32 = TransformerLM(_tiny_cfg())
+    params = nn.meta.unbox(
+        f32.init(jax.random.PRNGKey(0), {"tokens": prompt})["params"]
+    )
+    qmodel = TransformerLM(_tiny_cfg(weights_int8=True))
+    target = nn.meta.unbox(
+        qmodel.init(jax.random.PRNGKey(0), {"tokens": prompt})["params"]
+    )
+    got = quantize_params(params)
+    tgt_shapes = jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), target)
+    got_shapes = jax.tree_util.tree_map(lambda a: (a.shape, a.dtype), got)
+    assert tgt_shapes == got_shapes
+
+
+def test_int8_forward_close_to_f32(devices):
+    """Quantized forward logits stay close in relative terms — W8A16 is a
+    bandwidth layout, not a different model."""
+    import flax.linen as nn
+
+    from rocket_tpu.models.transformer import TransformerLM
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, 64, size=(2, 16)), jnp.int32)
+    f32 = TransformerLM(_tiny_cfg())
+    params = nn.meta.unbox(
+        f32.init(jax.random.PRNGKey(0), {"tokens": tokens})["params"]
+    )
+    ref = f32.apply({"params": params}, {"tokens": tokens})["logits"]
+    qmodel = TransformerLM(_tiny_cfg(weights_int8=True))
+    got = qmodel.apply(
+        {"params": quantize_params(params)}, {"tokens": tokens}
+    )["logits"]
+    ref = np.asarray(ref, np.float32)
+    got = np.asarray(got, np.float32)
+    denom = np.maximum(np.abs(ref).max(), 1e-6)
+    assert np.abs(got - ref).max() / denom < 0.12, (
+        np.abs(got - ref).max() / denom
+    )
+
+
+def test_int8_generate_end_to_end(devices):
+    """KV-cache decode runs with the quantized layout and emits tokens in
+    vocab range."""
+    import flax.linen as nn
+
+    from rocket_tpu.models.generate import generate
+    from rocket_tpu.models.transformer import TransformerLM
+
+    prompt = jnp.asarray(
+        np.random.default_rng(1).integers(0, 64, size=(2, 8)), jnp.int32
+    )
+    f32 = TransformerLM(_tiny_cfg())
+    params = nn.meta.unbox(
+        f32.init(jax.random.PRNGKey(0), {"tokens": prompt})["params"]
+    )
+    qmodel = TransformerLM(_tiny_cfg(weights_int8=True))
+    got = generate(
+        qmodel, quantize_params(params), prompt, max_new_tokens=6,
+        temperature=0.0,
+    )
+    assert got.shape == (2, 14)
+    assert np.all((np.asarray(got) >= 0) & (np.asarray(got) < 64))
+
+
+def test_weights_int8_rejects_fused_ce(devices):
+    with pytest.raises(ValueError, match="inference-only"):
+        _tiny_cfg(weights_int8=True, fused_ce=True)
+
+
+def test_weights_int8_rejects_scan_layers(devices):
+    with pytest.raises(ValueError, match="unrolled"):
+        _tiny_cfg(weights_int8=True, scan_layers=True)
+
+
+def test_quantize_params_handles_frozen_dict(devices):
+    """FrozenDict checkpoints (flax serialization) must quantize, not
+    pass through silently unquantized."""
+    import flax.core
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 32))
+    got = quantize_params(flax.core.freeze({"dense": {"kernel": w}}))
+    assert "kernel_q" in got["dense"] and "kernel_scale" in got["dense"]
+
+
+def test_quantize_params_rejects_stacked_kernels(devices):
+    """nn.scan stacks kernels to [L, K, N]; quantizing that layout would
+    silently skip it — it must fail loudly instead."""
+    w = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32))
+    with pytest.raises(ValueError, match="scan_layers"):
+        quantize_params({"blocks": {"mlp": {"kernel": w}}})
