@@ -596,6 +596,10 @@ def bench_gpt2_decode(n_steps, warmup):
         from rocket_tpu.ops.quant import quantize_params
 
         params = jax.jit(quantize_params)(params)
+        jax.block_until_ready(params)
+    # drop the f32 init tree before timing: keeping it live would leave
+    # f32 + bf16/int8 copies resident through the measured decode loop
+    del variables
 
     def run(params, prompt, key):
         return generate(model, params, prompt, NEW, rng=key, temperature=1.0)

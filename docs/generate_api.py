@@ -56,6 +56,7 @@ MODULES = {
     "rocket_tpu.ops.flash": "Pallas flash attention (TPU kernel)",
     "rocket_tpu.ops.fused_ce": "Fused logits-free linear cross-entropy",
     "rocket_tpu.ops.ring": "Ring attention (sequence parallel)",
+    "rocket_tpu.ops.quant": "Int8 weight-only quantization (W8A16 decode)",
     "rocket_tpu.observe.meter": "Meter / Metric (distributed eval metrics)",
     "rocket_tpu.observe.tracker": "Tracker + ImageLogger",
     "rocket_tpu.observe.backends": "Tracker backends",
@@ -67,6 +68,7 @@ MODULES = {
     "rocket_tpu.models.vit": "ViT family",
     "rocket_tpu.models.lenet": "LeNet (MNIST example model)",
     "rocket_tpu.models.lora": "LoRA utilities",
+    "rocket_tpu.models.generate": "Autoregressive generation (KV-cache decode, beam search)",
     "rocket_tpu.models.objectives": "Stock objectives",
     "rocket_tpu.utils.placement": "Collate + device placement",
     "rocket_tpu.utils.collections": "Pytree helpers",

@@ -161,9 +161,22 @@ class Embed(nn.Module):
 
     def __call__(self, tokens):
         if self.weights_int8:
+            dt = self.dtype if self.dtype is not None else jnp.bfloat16
+            if self._vocab_sharded():
+                # same reasoning as the f32 branch below: a gather from a
+                # vocab-sharded table forces a full rematerialization, so
+                # route through the one-hot matmul (dequant feeds the dot;
+                # the sharded case trades the int8 bandwidth win for a
+                # correct distributed layout)
+                from rocket_tpu.ops.quant import dequantize_int8
+
+                table = dequantize_int8(
+                    self.embedding_q, self.embedding_scale, axis=1, dtype=dt
+                )
+                one_hot = jax.nn.one_hot(tokens, self.vocab_size, dtype=dt)
+                return one_hot @ table
             # Gathering B*S int8 rows + scales is negligible traffic; the
             # dequant happens on the gathered slice, never the full table.
-            dt = self.dtype if self.dtype is not None else jnp.bfloat16
             rows = jnp.asarray(self.embedding_q)[tokens].astype(dt)
             s = jnp.asarray(self.embedding_scale)[tokens].astype(dt)
             return rows * s[..., None]

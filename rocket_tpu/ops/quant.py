@@ -121,10 +121,14 @@ def int8_matmul(x: jax.Array, q: jax.Array, scale: jax.Array, *,
 
     ``x`` is ``[..., K]`` (leading dims flattened internally); ``q`` is
     ``[K, N]`` (or ``[N, K]`` with ``nk_layout=True`` — the natural layout
-    of a tied embedding table); ``scale`` is ``[N]`` f32.  Rows beyond
-    :data:`KERNEL_MAX_ROWS` fall back to a dequant-einsum (prefill and
-    training shapes are compute-bound; the kernel exists for the
-    bandwidth-bound one-token-per-step decode loop).
+    of a tied embedding table); ``scale`` is ``[N]`` f32.  Two conditions
+    route to a dequant-einsum fallback instead of the kernel: rows beyond
+    :data:`KERNEL_MAX_ROWS` (prefill/training shapes are compute-bound;
+    the kernel exists for the bandwidth-bound one-token-per-step decode
+    loop), and ``K % 128 != 0`` (the kernel loads full-K tiles on
+    128-lane boundaries) — the fallback dequantizes the FULL weight
+    matrix, so a contraction dim that isn't a multiple of 128 gets no
+    bandwidth saving; pad the model dims if the int8 read path matters.
     """
     lead = x.shape[:-1]
     K = x.shape[-1]
