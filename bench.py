@@ -452,7 +452,10 @@ def bench_gpt2(n_steps, warmup, tune=None):
     batch, seq = t["batch"], t["seq"]
     cfg = TransformerConfig.gpt2_124m(**_gpt2_cfg_kwargs(t))
     opt_kw = {}
-    if t.get("mu_dtype", "f32") == "bf16":
+    mu = t.get("mu_dtype", "f32")
+    if mu not in ("f32", "bf16"):
+        raise ValueError(f"mu_dtype must be 'f32' or 'bf16', got {mu!r}")
+    if mu == "bf16":
         opt_kw["mu_dtype"] = jnp.bfloat16  # forwarded to optax.adamw
     module = rt.Module(
         TransformerLM(cfg),

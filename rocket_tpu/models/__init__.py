@@ -1,5 +1,11 @@
 from rocket_tpu.models import objectives
 from rocket_tpu.models.layers import Embed, PDense, RMSNorm, apply_rope, rotary_embedding
+from rocket_tpu.models.generate import (
+    beam_search_seq2seq,
+    generate,
+    generate_seq2seq,
+    speculative_generate,
+)
 from rocket_tpu.models.lenet import LeNet
 from rocket_tpu.models.lora import freeze_non_lora, freeze_where, is_lora, lora_labels, merge_lora
 from rocket_tpu.models.resnet import ResNet, resnet18, resnet50
@@ -9,6 +15,10 @@ from rocket_tpu.models.vit import ViT, ViTConfig
 
 __all__ = [
     "Embed",
+    "beam_search_seq2seq",
+    "generate",
+    "generate_seq2seq",
+    "speculative_generate",
     "EncoderDecoder",
     "LeNet",
     "PDense",

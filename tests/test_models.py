@@ -567,6 +567,97 @@ def test_generate_with_bf16_cast_params(devices):
     assert jnp.all((got >= 0) & (got < 64))
 
 
+def test_speculative_generate_matches_plain_greedy(devices):
+    """Speculative decoding is an EXACTNESS contract: whatever the draft
+    proposes (here: a differently-initialized model that disagrees
+    often), the output must be identical to plain greedy decoding with
+    the target alone."""
+    from rocket_tpu.models.generate import generate, speculative_generate
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=64,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+    )
+    draft_cfg = TransformerConfig(
+        vocab_size=64, hidden=16, n_layers=1, n_heads=2, max_seq=64,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+    )
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(1, 8)), jnp.int32
+    )
+    model = TransformerLM(cfg)
+    draft = TransformerLM(draft_cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+    draft_params = nn.meta.unbox(
+        draft.init(jax.random.PRNGKey(2), {"tokens": prompt})["params"]
+    )
+
+    want = generate(model, params, prompt, max_new_tokens=17,
+                    temperature=0.0)
+    for n_draft in (1, 3, 4):
+        got = speculative_generate(
+            model, params, draft, draft_params, prompt,
+            max_new_tokens=17, n_draft=n_draft,
+        )
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_speculative_generate_perfect_draft(devices):
+    """With the target as its own draft every proposal is accepted — the
+    degenerate upper bound must still be exact."""
+    from rocket_tpu.models.generate import generate, speculative_generate
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=64,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+    )
+    prompt = jnp.asarray(
+        np.random.default_rng(3).integers(0, 64, size=(1, 6)), jnp.int32
+    )
+    model = TransformerLM(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+    want = generate(model, params, prompt, max_new_tokens=12,
+                    temperature=0.0)
+    got, stats = speculative_generate(
+        model, params, model, params, prompt, max_new_tokens=12, n_draft=4,
+        return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+    # a perfect draft must accept EVERY proposal in EVERY round — this is
+    # what catches draft-cache corruption that output exactness cannot
+    # (the target re-verifies everything): 11 tokens after the prefill
+    # one, 5 per round -> exactly 3 rounds, all drafts accepted
+    assert stats["accepted"] == stats["drafted"], stats
+    assert stats["rounds"] == 3, stats
+
+
+def test_speculative_generate_rejects_batch(devices):
+    from rocket_tpu.models.generate import speculative_generate
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=16, n_layers=1, n_heads=2, max_seq=32,
+        attention="dot", norm="layernorm", mlp="gelu",
+        positions="learned", tie_embeddings=True, use_bias=True,
+    )
+    model = TransformerLM(cfg)
+    prompt = jnp.zeros((2, 4), jnp.int32)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), {"tokens": prompt})["params"]
+    )
+    with pytest.raises(ValueError, match="batch=1"):
+        speculative_generate(model, params, model, params, prompt, 4)
+
+
 def test_generate_sampling_shapes_and_jit(devices):
     """Temperature/top-k sampling path runs under jit and respects the
     vocab bound."""
