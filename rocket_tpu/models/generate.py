@@ -192,6 +192,25 @@ def _set_cache_index(cache: Any, value) -> Any:
     return rewound
 
 
+@functools.partial(jax.jit, static_argnums=0)
+def _chunk_step(model, params, cache, toks, pos0):
+    """Apply ``toks`` ([1, S]) at positions pos0..pos0+S-1; returns
+    (cache, greedy next-token per position [1, S]).
+
+    Module-level jit with the (hashable) flax module static and params
+    traced: the compiled executables persist across
+    :func:`speculative_generate` calls — a serving loop pays compilation
+    once per (model, shape), not per request."""
+    S = toks.shape[1]
+    positions = pos0 + jnp.arange(S, dtype=jnp.int32)[None, :]
+    out, mutated = model.apply(
+        {"params": params, "cache": cache},
+        {"tokens": toks, "positions": positions},
+        decode=True, mutable=["cache"],
+    )
+    return mutated["cache"], jnp.argmax(out["logits"], axis=-1)
+
+
 def speculative_generate(
     model: Any,
     params: Any,
@@ -234,26 +253,12 @@ def speculative_generate(
             f"exceeds a model's max_seq"
         )
 
-    def chunk_step(m, p, cache, toks, pos0):
-        """Apply ``toks`` ([1, S]) at positions pos0..pos0+S-1; returns
-        (cache, greedy next-token per position [1, S])."""
-        S = toks.shape[1]
-        positions = pos0 + jnp.arange(S, dtype=jnp.int32)[None, :]
-        out, mutated = m.apply(
-            {"params": p, "cache": cache},
-            {"tokens": toks, "positions": positions},
-            decode=True, mutable=["cache"],
-        )
-        return mutated["cache"], jnp.argmax(out["logits"], axis=-1)
-
     if max_new_tokens <= 0:
         return (prompt, {"rounds": 0, "drafted": 0, "accepted": 0}) \
             if return_stats else prompt
 
-    target_step = jax.jit(functools.partial(chunk_step, model, params))
-    draft_step = jax.jit(
-        functools.partial(chunk_step, draft_model, draft_params)
-    )
+    target_step = functools.partial(_chunk_step, model, params)
+    draft_step = functools.partial(_chunk_step, draft_model, draft_params)
 
     # prefill both; the target's last-position argmax is the first
     # pending token g (known-correct, not yet processed by either model)
