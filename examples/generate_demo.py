@@ -129,7 +129,10 @@ def main():
     from rocket_tpu.models.generate import speculative_generate
 
     one = prompts[:1]
-    plain = bf16[:1]  # the timed greedy run above already decoded row 0
+    # the exactness contract is against a batch-1 greedy decode (a
+    # batch-4 forward may reassociate reductions and flip argmax ties)
+    plain = generate(model, params, one, max_new_tokens=args.new_tokens,
+                     temperature=0.0)
     spec, stats = speculative_generate(
         model, params, qmodel, qparams, one,
         max_new_tokens=args.new_tokens, n_draft=4, return_stats=True,

@@ -97,6 +97,7 @@ def generate(
     temperature: float = 1.0,
     top_k: Optional[int] = None,
     top_p: Optional[float] = None,
+    eos_token: Optional[int] = None,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations of ``prompt`` (``[B, P]``
     int32) with a KV cache; returns ``[B, P + max_new_tokens]`` tokens.
@@ -106,9 +107,19 @@ def generate(
     ``remat=False``, no pipeline).  ``P + max_new_tokens`` must fit in
     ``config.max_seq``.  Wrap in ``jax.jit`` (static
     ``max_new_tokens``/``temperature``/``top_k``) for repeated use.
+
+    ``eos_token``: rows that emit it keep repeating it for the rest of
+    the fixed-length output (shapes stay static under jit — trim on the
+    host). Sampling randomness is consumed identically either way, so
+    the pre-EOS prefix matches the no-eos call bit for bit.
     """
     cfg = model.config
     B, P = prompt.shape
+    if max_new_tokens < 1:
+        # scan(length=max_new_tokens-1) would die on a negative length
+        # far from the caller's mistake — and 0 would still emit the
+        # prefill sample; fail loudly instead
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
     total = P + max_new_tokens
     if total > cfg.max_seq:
         raise ValueError(
@@ -130,9 +141,12 @@ def generate(
     cache = mutated["cache"]
     rng, sub = jax.random.split(rng)
     tok = _sample(out["logits"][:, -1], sub, temperature, top_k, top_p)
+    done = jnp.zeros((B,), bool) if eos_token is None else tok == eos_token
+    if eos_token is not None:
+        eos = jnp.asarray(eos_token, jnp.int32)
 
     def step(carry, _):
-        cache, tok, rng, pos = carry
+        cache, tok, rng, pos, done = carry
         batch = {
             "tokens": tok[:, None],
             "positions": jnp.broadcast_to(pos[None, None], (B, 1)),
@@ -143,10 +157,13 @@ def generate(
         )
         rng, sub = jax.random.split(rng)
         nxt = _sample(out["logits"][:, 0], sub, temperature, top_k, top_p)
-        return (mutated["cache"], nxt, rng, pos + 1), tok
+        if eos_token is not None:
+            nxt = jnp.where(done, eos, nxt)
+            done = done | (nxt == eos)
+        return (mutated["cache"], nxt, rng, pos + 1, done), tok
 
-    init = (cache, tok, rng, jnp.asarray(P, jnp.int32))
-    (cache, tok, rng, _), toks = jax.lax.scan(
+    init = (cache, tok, rng, jnp.asarray(P, jnp.int32), done)
+    (cache, tok, rng, _, done), toks = jax.lax.scan(
         step, init, None, length=max_new_tokens - 1
     )
     # toks holds tokens emitted at steps 0..max_new-2; the final carry tok

@@ -567,6 +567,50 @@ def test_generate_with_bf16_cast_params(devices):
     assert jnp.all((got >= 0) & (got < 64))
 
 
+def test_generate_eos_token_freezes_finished_rows(devices):
+    """After a row emits eos, every later position repeats eos (static
+    shapes under jit; the host trims), and the pre-EOS prefix is
+    bit-identical to the no-eos call."""
+    from rocket_tpu.models.generate import generate
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=64,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+    )
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, size=(2, 6)), jnp.int32
+    )
+    model = TransformerLM(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+    free = np.asarray(
+        generate(model, params, prompt, max_new_tokens=20, temperature=0.0)
+    )
+    # pick an eos the free-running greedy output actually emits so the
+    # freeze path is exercised
+    eos = int(free[0, 6 + 2])
+    got = np.asarray(
+        generate(model, params, prompt, max_new_tokens=20, temperature=0.0,
+                 eos_token=eos)
+    )
+    assert got.shape == free.shape
+    for row in range(got.shape[0]):
+        cont_free, cont = free[row, 6:], got[row, 6:]
+        hits = np.nonzero(cont == eos)[0]
+        if hits.size:
+            first = hits[0]
+            # identical before the first eos, frozen at eos after
+            np.testing.assert_array_equal(cont[:first], cont_free[:first])
+            assert np.all(cont[first:] == eos)
+        else:
+            np.testing.assert_array_equal(cont, cont_free)
+    # row 0 must actually have frozen (we chose its own 3rd token)
+    assert np.any(got[0, 6:] == eos)
+
+
 def test_speculative_generate_matches_plain_greedy(devices):
     """Speculative decoding is an EXACTNESS contract: whatever the draft
     proposes (here: a differently-initialized model that disagrees
