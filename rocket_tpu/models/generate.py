@@ -237,6 +237,7 @@ def speculative_generate(
     max_new_tokens: int,
     n_draft: int = 4,
     return_stats: bool = False,
+    eos_token: Optional[int] = None,
 ) -> Any:
     """Greedy speculative decoding: a small draft model proposes
     ``n_draft`` tokens per round and the target verifies the whole block
@@ -256,6 +257,10 @@ def speculative_generate(
     ``return_stats=True``, a ``(tokens, stats)`` tuple where ``stats``
     counts ``rounds`` / ``drafted`` / ``accepted`` (acceptance rate is
     the whole bandwidth win; a perfect draft accepts everything).
+
+    ``eos_token`` matches :func:`generate`'s fixed-length contract: the
+    output keeps the prefix through the first eos and fills the rest
+    with eos (decoding stops early — that, not shape, is the saving).
     """
     B, P = prompt.shape
     if B != 1:
@@ -288,6 +293,11 @@ def speculative_generate(
     tokens = list(np.asarray(prompt[0])) + [g]
     n_out = 1
     stats = {"rounds": 0, "drafted": 0, "accepted": 0}
+    if eos_token is not None and g == eos_token:
+        # the very first greedy token finished the row: emit the frozen
+        # all-eos tail (same fixed-length contract as generate())
+        tokens.extend([eos_token] * (max_new_tokens - 1))
+        n_out = max_new_tokens
     pos = P      # target frontier: cache slots [0, pos) are valid
     d_pos = P    # draft frontier — may trail pos by one fully-accepted
     # draft d_k the draft proposed but never processed (see below)
@@ -322,11 +332,20 @@ def speculative_generate(
         # accept d_1..d_j plus the target's own next token y_j — all
         # exactly what plain greedy decoding would have produced
         new_toks = (d_toks[:j] + [int(y_np[j])])[: max_new_tokens - n_out]
-        tokens.extend(new_toks)
-        n_out += len(new_toks)
         stats["rounds"] += 1
         stats["drafted"] += k
         stats["accepted"] += j
+        finished = eos_token is not None and eos_token in new_toks
+        if finished:
+            # freeze at eos exactly like generate(): keep the prefix
+            # through the first eos, fill the rest of the fixed-length
+            # output with eos, and stop decoding
+            new_toks = new_toks[: new_toks.index(eos_token) + 1]
+        tokens.extend(new_toks)
+        n_out += len(new_toks)
+        if finished:
+            tokens.extend([eos_token] * (max_new_tokens - n_out))
+            break
         # accepted prefix: ..., g, d_1..d_j (the new pending token is the
         # last accepted one, still unprocessed)
         pos = pos + 1 + j

@@ -684,6 +684,54 @@ def test_speculative_generate_perfect_draft(devices):
     assert stats["rounds"] == 3, stats
 
 
+def test_speculative_generate_eos_matches_generate_eos(devices):
+    """speculative + eos must reproduce generate + eos exactly: prefix
+    through the first eos, all-eos frozen tail after."""
+    from rocket_tpu.models.generate import generate, speculative_generate
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=64,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+    )
+    prompt = jnp.asarray(
+        np.random.default_rng(6).integers(0, 64, size=(1, 6)), jnp.int32
+    )
+    model = TransformerLM(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+    free = np.asarray(
+        generate(model, params, prompt, max_new_tokens=16, temperature=0.0)
+    )
+    eos = int(free[0, 6 + 3])  # an eos the greedy run actually emits
+    want = np.asarray(
+        generate(model, params, prompt, max_new_tokens=16, temperature=0.0,
+                 eos_token=eos)
+    )
+    got = np.asarray(
+        speculative_generate(model, params, model, params, prompt,
+                             max_new_tokens=16, n_draft=4, eos_token=eos)
+    )
+    np.testing.assert_array_equal(got, want)
+    assert np.any(got[0, 6:] == eos)
+
+    # prefill-token-is-eos branch: eos = the FIRST greedy token makes
+    # the whole continuation a frozen all-eos tail in both functions
+    eos0 = int(free[0, 6])
+    want0 = np.asarray(
+        generate(model, params, prompt, max_new_tokens=16, temperature=0.0,
+                 eos_token=eos0)
+    )
+    got0 = np.asarray(
+        speculative_generate(model, params, model, params, prompt,
+                             max_new_tokens=16, n_draft=4, eos_token=eos0)
+    )
+    np.testing.assert_array_equal(got0, want0)
+    assert np.all(got0[0, 6:] == eos0)
+
+
 def test_speculative_generate_rejects_batch(devices):
     from rocket_tpu.models.generate import speculative_generate
     from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
