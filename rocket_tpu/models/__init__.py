@@ -5,6 +5,7 @@ from rocket_tpu.models.generate import (
     generate,
     generate_seq2seq,
     speculative_generate,
+    speculative_sample,
 )
 from rocket_tpu.models.lenet import LeNet
 from rocket_tpu.models.lora import freeze_non_lora, freeze_where, is_lora, lora_labels, merge_lora
@@ -19,6 +20,7 @@ __all__ = [
     "generate",
     "generate_seq2seq",
     "speculative_generate",
+    "speculative_sample",
     "EncoderDecoder",
     "LeNet",
     "PDense",

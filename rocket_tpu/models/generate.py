@@ -228,6 +228,96 @@ def _chunk_step(model, params, cache, toks, pos0):
     return mutated["cache"], jnp.argmax(out["logits"], axis=-1)
 
 
+def _speculative_loop(
+    caller: str,
+    model: Any,
+    draft_model: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    n_draft: int,
+    return_stats: bool,
+    eos_token: Optional[int],
+    prefill,
+    do_round,
+    rewind,
+):
+    """Shared round loop for both speculative variants.
+
+    Owns everything variant-independent: validation, the token list and
+    frontier arithmetic (``pos`` = target frontier = ``len(tokens) - 1``,
+    the pending token is always ``tokens[-1]``; the draft frontier ends a
+    round at ``pos + k`` and is clamped to the accepted prefix), the
+    fixed-length eos contract, truncation, and stats.  The variants
+    supply ``prefill() -> g``, ``do_round(feed, feed_start, pending,
+    pos, k) -> (drafts, extra_token, j)`` (drafting, the single target
+    verification forward, and the accept rule), and ``rewind(pos,
+    d_pos)`` (cache-index rewinds — the caches live in the variant's
+    closure).
+    """
+    B, P = prompt.shape
+    if B != 1:
+        raise ValueError(
+            f"{caller} requires batch=1 (got {B}): acceptance length is "
+            f"data-dependent per row"
+        )
+    if n_draft < 1:
+        raise ValueError(f"{caller} needs n_draft >= 1, got {n_draft}")
+    total = P + max_new_tokens
+    if total > model.config.max_seq or total > draft_model.config.max_seq:
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds a model's max_seq"
+        )
+    if max_new_tokens <= 0:
+        return (prompt, {"rounds": 0, "drafted": 0, "accepted": 0}) \
+            if return_stats else prompt
+
+    g = prefill()
+
+    # all known-correct tokens; the LAST one is always the pending token
+    # (not yet processed by either model)
+    tokens = list(np.asarray(prompt[0])) + [g]
+    n_out = 1
+    stats = {"rounds": 0, "drafted": 0, "accepted": 0}
+    if eos_token is not None and g == eos_token:
+        # the very first token finished the row: emit the frozen all-eos
+        # tail (same fixed-length contract as generate())
+        tokens.extend([eos_token] * (max_new_tokens - 1))
+        n_out = max_new_tokens
+    d_pos = P    # draft frontier — may trail pos by one fully-accepted
+    # draft d_k the draft proposed but never processed: the catch-up
+    # feed (tokens[d_pos:]) covers it next round; skipping it would
+    # leave an unwritten KV slot every later draft step attends to,
+    # silently collapsing the acceptance rate
+    while n_out < max_new_tokens:
+        pos = len(tokens) - 1  # target frontier: slots [0, pos) valid
+        k = min(n_draft, max_new_tokens - n_out)
+        drafts, tok, j = do_round(tokens[d_pos:], d_pos, tokens[-1], pos, k)
+        d_pos = pos + k  # draft processed ...d_{k-1}, only PROPOSED d_k
+        # accept d_1..d_j plus the round's extra token (greedy: the
+        # target's own next token; sampling: the resample/bonus draw)
+        new_toks = (drafts[:j] + [tok])[: max_new_tokens - n_out]
+        stats["rounds"] += 1
+        stats["drafted"] += k
+        stats["accepted"] += j
+        finished = eos_token is not None and eos_token in new_toks
+        if finished:
+            # freeze at eos exactly like generate(): keep the prefix
+            # through the first eos, fill the rest of the fixed-length
+            # output with eos, and stop decoding
+            new_toks = new_toks[: new_toks.index(eos_token) + 1]
+        tokens.extend(new_toks)
+        n_out += len(new_toks)
+        if finished:
+            tokens.extend([eos_token] * (max_new_tokens - n_out))
+            break
+        d_pos = min(d_pos, len(tokens) - 1)
+        rewind(len(tokens) - 1, d_pos)
+
+    out = jnp.asarray(tokens, jnp.int32)[None, :]
+    return (out, stats) if return_stats else out
+
+
 def speculative_generate(
     model: Any,
     params: Any,
@@ -262,99 +352,196 @@ def speculative_generate(
     output keeps the prefix through the first eos and fills the rest
     with eos (decoding stops early — that, not shape, is the saving).
     """
-    B, P = prompt.shape
-    if B != 1:
-        raise ValueError(
-            f"speculative_generate requires batch=1 (got {B}): acceptance "
-            f"length is data-dependent per row"
-        )
-    total = P + max_new_tokens
-    if total > model.config.max_seq or total > draft_model.config.max_seq:
-        raise ValueError(
-            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) = {total} "
-            f"exceeds a model's max_seq"
-        )
-
-    if max_new_tokens <= 0:
-        return (prompt, {"rounds": 0, "drafted": 0, "accepted": 0}) \
-            if return_stats else prompt
-
     target_step = functools.partial(_chunk_step, model, params)
     draft_step = functools.partial(_chunk_step, draft_model, draft_params)
+    caches = {}
 
-    # prefill both; the target's last-position argmax is the first
-    # pending token g (known-correct, not yet processed by either model)
-    t_cache, t_greedy = target_step(zero_cache(model, params, prompt), prompt, 0)
-    d_cache, _ = draft_step(zero_cache(draft_model, draft_params, prompt), prompt, 0)
-    g = int(np.asarray(t_greedy)[0, -1])
+    def prefill():
+        # the target's last-position argmax is the first pending token g
+        caches["t"], t_greedy = target_step(
+            zero_cache(model, params, prompt), prompt, 0
+        )
+        caches["d"], _ = draft_step(
+            zero_cache(draft_model, draft_params, prompt), prompt, 0
+        )
+        return int(np.asarray(t_greedy[0, -1]))
 
-    # all known-correct tokens; the LAST one is always the pending token
-    # (not yet processed by either model)
-    tokens = list(np.asarray(prompt[0])) + [g]
-    n_out = 1
-    stats = {"rounds": 0, "drafted": 0, "accepted": 0}
-    if eos_token is not None and g == eos_token:
-        # the very first greedy token finished the row: emit the frozen
-        # all-eos tail (same fixed-length contract as generate())
-        tokens.extend([eos_token] * (max_new_tokens - 1))
-        n_out = max_new_tokens
-    pos = P      # target frontier: cache slots [0, pos) are valid
-    d_pos = P    # draft frontier — may trail pos by one fully-accepted
-    # draft d_k the draft proposed but never processed (see below)
-    while n_out < max_new_tokens:
-        k = min(n_draft, max_new_tokens - n_out)
-        # draft catch-up + first proposal: feed every known token the
-        # draft hasn't processed (ends with the pending one). After a
-        # fully-accepted round this is [d_k, g'] — skipping d_k would
-        # leave an unwritten KV slot that every later draft step attends
-        # to, silently collapsing the acceptance rate.
-        feed = jnp.asarray(tokens[d_pos:], jnp.int32)[None, :]
-        d_cache, nxt = draft_step(d_cache, feed, d_pos)
-        d_pos += feed.shape[1]
-        d_toks = [int(np.asarray(nxt)[0, -1])]
+    def do_round(feed_toks, feed_start, pending, pos, k):
+        feed = jnp.asarray(feed_toks, jnp.int32)[None, :]
+        caches["d"], nxt = draft_step(caches["d"], feed, feed_start)
+        dp = feed_start + len(feed_toks)
+        d_toks = [int(np.asarray(nxt[0, -1]))]
         for _ in range(k - 1):
-            d_cache, nxt = draft_step(
-                d_cache, jnp.asarray([[d_toks[-1]]], jnp.int32), d_pos
+            caches["d"], nxt = draft_step(
+                caches["d"], jnp.asarray([[d_toks[-1]]], jnp.int32), dp
             )
-            d_pos += 1
-            d_toks.append(int(np.asarray(nxt)[0, -1]))
-        # draft processed ...d_{k-1} but only PROPOSED d_k — d_pos == pos+k
+            dp += 1
+            d_toks.append(int(np.asarray(nxt[0, -1])))
 
         # ONE target forward over [g, d_1..d_k]: position i's argmax is
         # the target's greedy token AFTER seeing chunk[:i+1]
-        chunk = jnp.asarray([[tokens[-1]] + d_toks], jnp.int32)  # [1, k+1]
-        t_cache, t_next = target_step(t_cache, chunk, pos)
-        y_np = np.asarray(t_next)[0]
-
+        chunk = jnp.asarray([[pending] + d_toks], jnp.int32)
+        caches["t"], t_next = target_step(caches["t"], chunk, pos)
+        y_np = np.asarray(t_next[0])
         j = 0
         while j < k and d_toks[j] == y_np[j]:
             j += 1
-        # accept d_1..d_j plus the target's own next token y_j — all
-        # exactly what plain greedy decoding would have produced
-        new_toks = (d_toks[:j] + [int(y_np[j])])[: max_new_tokens - n_out]
-        stats["rounds"] += 1
-        stats["drafted"] += k
-        stats["accepted"] += j
-        finished = eos_token is not None and eos_token in new_toks
-        if finished:
-            # freeze at eos exactly like generate(): keep the prefix
-            # through the first eos, fill the rest of the fixed-length
-            # output with eos, and stop decoding
-            new_toks = new_toks[: new_toks.index(eos_token) + 1]
-        tokens.extend(new_toks)
-        n_out += len(new_toks)
-        if finished:
-            tokens.extend([eos_token] * (max_new_tokens - n_out))
-            break
-        # accepted prefix: ..., g, d_1..d_j (the new pending token is the
-        # last accepted one, still unprocessed)
-        pos = pos + 1 + j
-        t_cache = _set_cache_index(t_cache, pos)
-        d_pos = min(d_pos, pos)
-        d_cache = _set_cache_index(d_cache, d_pos)
+        return d_toks, int(y_np[j]), j
 
-    out = jnp.asarray(tokens, jnp.int32)[None, :]
-    return (out, stats) if return_stats else out
+    def rewind(pos, d_pos):
+        caches["t"] = _set_cache_index(caches["t"], pos)
+        caches["d"] = _set_cache_index(caches["d"], d_pos)
+
+    return _speculative_loop(
+        "speculative_generate", model, draft_model, prompt, max_new_tokens,
+        n_draft, return_stats, eos_token, prefill, do_round, rewind,
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0, static_argnames=("temperature",))
+def _chunk_probs(model, params, cache, toks, pos0, *, temperature=1.0):
+    """Like :func:`_chunk_step` but returns the full next-token
+    probability rows ([1, S, V], f32 softmax at ``temperature``) instead
+    of argmaxes — the speculative-SAMPLING verifier needs p and q."""
+    S = toks.shape[1]
+    positions = pos0 + jnp.arange(S, dtype=jnp.int32)[None, :]
+    out, mutated = model.apply(
+        {"params": params, "cache": cache},
+        {"tokens": toks, "positions": positions},
+        decode=True, mutable=["cache"],
+    )
+    probs = jax.nn.softmax(
+        out["logits"].astype(jnp.float32) / temperature, axis=-1
+    )
+    return mutated["cache"], probs
+
+
+def _norm_row(row: "np.ndarray") -> "np.ndarray":
+    """Renormalize an f32 softmax row in float64 for numpy's choice()."""
+    row = np.asarray(row, np.float64)
+    return row / row.sum()
+
+
+def speculative_sample(
+    model: Any,
+    params: Any,
+    draft_model: Any,
+    draft_params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    n_draft: int = 4,
+    temperature: float = 1.0,
+    seed: int = 0,
+    return_stats: bool = False,
+    eos_token: Optional[int] = None,
+) -> Any:
+    """Speculative SAMPLING (rejection-based): like
+    :func:`speculative_generate` but for ``temperature > 0`` — the draft
+    proposes from its own distribution q, the target verifies the block
+    in one forward, and each proposal is accepted with probability
+    ``min(1, p/q)``; a rejection resamples from ``max(0, p - q)``.  The
+    emitted tokens are distributed EXACTLY according to the target's
+    sampling distribution p, whatever the draft is
+    (:func:`_accept_resample` carries the math and its distributional
+    test).  Batch must be 1; acceptance randomness runs on the host
+    (``numpy`` generator seeded by ``seed``), so a fixed seed gives a
+    reproducible trace.  Shares :func:`_speculative_loop`'s frontier /
+    eos / stats machinery with the greedy variant.
+    """
+    if temperature <= 0.0:
+        raise ValueError(
+            "speculative_sample needs temperature > 0; use "
+            "speculative_generate for greedy decoding"
+        )
+    host = np.random.default_rng(seed)
+    target_step = functools.partial(
+        _chunk_probs, model, params, temperature=temperature
+    )
+    draft_step = functools.partial(
+        _chunk_probs, draft_model, draft_params, temperature=temperature
+    )
+    caches = {}
+
+    def prefill():
+        caches["t"], t_probs = target_step(
+            zero_cache(model, params, prompt), prompt, 0
+        )
+        caches["d"], _ = draft_step(
+            zero_cache(draft_model, draft_params, prompt), prompt, 0
+        )
+        row = _norm_row(np.asarray(t_probs[0, -1]))  # device-slice first
+        return int(host.choice(row.shape[0], p=row))
+
+    def do_round(feed_toks, feed_start, pending, pos, k):
+        feed = jnp.asarray(feed_toks, jnp.int32)[None, :]
+        caches["d"], d_probs = draft_step(caches["d"], feed, feed_start)
+        dp = feed_start + len(feed_toks)
+        q_rows = [np.asarray(d_probs[0, -1])]
+        V = q_rows[0].shape[0]
+        drafts = [int(host.choice(V, p=_norm_row(q_rows[0])))]
+        for _ in range(k - 1):
+            caches["d"], d_probs = draft_step(
+                caches["d"], jnp.asarray([[drafts[-1]]], jnp.int32), dp
+            )
+            dp += 1
+            q_rows.append(np.asarray(d_probs[0, -1]))
+            drafts.append(int(host.choice(V, p=_norm_row(q_rows[-1]))))
+
+        chunk = jnp.asarray([[pending] + drafts], jnp.int32)
+        caches["t"], t_probs = target_step(caches["t"], chunk, pos)
+        p_rows = np.asarray(t_probs[0])  # [k+1, V] — every row is needed
+        j, tok = _accept_resample(
+            p_rows, np.stack(q_rows), np.asarray(drafts), host
+        )
+        return drafts, tok, j
+
+    def rewind(pos, d_pos):
+        caches["t"] = _set_cache_index(caches["t"], pos)
+        caches["d"] = _set_cache_index(caches["d"], d_pos)
+
+    return _speculative_loop(
+        "speculative_sample", model, draft_model, prompt, max_new_tokens,
+        n_draft, return_stats, eos_token, prefill, do_round, rewind,
+    )
+
+
+def _accept_resample(p_rows: "np.ndarray", q_rows: "np.ndarray",
+                     drafts: "np.ndarray", rng: "np.random.Generator"):
+    """The speculative-SAMPLING core (host-side, pure numpy).
+
+    Given the target's next-token distributions ``p_rows`` ([k+1, V]:
+    row i is the target dist AFTER the i-th chunk token), the draft's
+    distributions ``q_rows`` ([k, V]) and its proposals ``drafts``
+    ([k]), returns ``(j, token)``: ``j`` accepted proposals and the
+    round's final emitted token — a rejection-resample from
+    ``max(0, p - q)`` at the first rejection, or a bonus sample from
+    ``p_rows[k]`` when everything is accepted.
+
+    This is the standard speculative-sampling rule: accept ``d_i`` with
+    probability ``min(1, p(d_i)/q(d_i))``; the combined emitted-token
+    distribution is EXACTLY ``p`` regardless of ``q`` (unit-tested
+    distributionally in ``tests/test_models.py``).
+    """
+    k = drafts.shape[0]
+    V = p_rows.shape[1]
+    for i in range(k):
+        d = int(drafts[i])
+        p_d = float(p_rows[i, d])
+        q_d = float(q_rows[i, d])
+        # q_d == 0 cannot happen for a token actually sampled from q;
+        # treat it as a rejection rather than dividing by zero
+        if q_d > 0.0 and rng.random() < min(1.0, p_d / q_d):
+            continue
+        residual = np.maximum(
+            np.asarray(p_rows[i], np.float64)
+            - np.asarray(q_rows[i], np.float64),
+            0.0,
+        )
+        total = float(residual.sum())
+        probs = residual / total if total > 0.0 else _norm_row(p_rows[i])
+        return i, int(rng.choice(V, p=probs))
+    # all k accepted: bonus token straight from the target
+    return k, int(rng.choice(V, p=_norm_row(p_rows[k])))
 
 
 def _seq2seq_prepare(model, params, inputs, inputs_mask, max_new_tokens):

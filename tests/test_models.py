@@ -732,6 +732,66 @@ def test_speculative_generate_eos_matches_generate_eos(devices):
     assert np.all(got0[0, 6:] == eos0)
 
 
+def test_accept_resample_first_token_marginal_is_target(devices):
+    """The speculative-sampling theorem, tested on the extracted core:
+    whatever the draft distribution q, the round's first emitted token
+    (accepted d_1, or the rejection resample) is distributed exactly per
+    the target's p — checked empirically on fixed p/q over 20k trials."""
+    from rocket_tpu.models.generate import _accept_resample
+
+    rng = np.random.default_rng(0)
+    V, k, N = 6, 2, 20_000
+    p0 = np.array([0.35, 0.05, 0.2, 0.1, 0.25, 0.05])
+    p1 = np.array([0.1, 0.3, 0.1, 0.2, 0.2, 0.1])
+    p2 = np.array([0.4, 0.1, 0.1, 0.1, 0.2, 0.1])
+    q0 = np.array([0.1, 0.4, 0.1, 0.2, 0.1, 0.1])  # very unlike p0
+    q1 = np.array([0.2, 0.2, 0.2, 0.2, 0.1, 0.1])
+    p_rows = np.stack([p0, p1, p2]).astype(np.float32)
+    q_rows = np.stack([q0, q1]).astype(np.float32)
+
+    counts = np.zeros(V)
+    for _ in range(N):
+        drafts = np.array([rng.choice(V, p=q0), rng.choice(V, p=q1)])
+        j, tok = _accept_resample(p_rows, q_rows, drafts, rng)
+        first = int(drafts[0]) if j >= 1 else tok
+        counts[first] += 1
+    tv = 0.5 * np.abs(counts / N - p0).sum()
+    assert tv < 0.03, (tv, counts / N)
+
+
+def test_speculative_sample_identical_draft_accepts_everything(devices):
+    """p == q makes the accept probability min(1, p/q) = 1: the target
+    drafting for itself must accept every proposal, and the run must be
+    reproducible from the seed."""
+    from rocket_tpu.models.generate import speculative_sample
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=64,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+    )
+    prompt = jnp.asarray(
+        np.random.default_rng(8).integers(0, 64, size=(1, 6)), jnp.int32
+    )
+    model = TransformerLM(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+    out, stats = speculative_sample(
+        model, params, model, params, prompt, max_new_tokens=14,
+        n_draft=4, temperature=0.9, seed=7, return_stats=True,
+    )
+    assert out.shape == (1, 20)
+    assert np.all((np.asarray(out) >= 0) & (np.asarray(out) < 64))
+    assert stats["accepted"] == stats["drafted"], stats
+    again = speculative_sample(
+        model, params, model, params, prompt, max_new_tokens=14,
+        n_draft=4, temperature=0.9, seed=7,
+    )
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(again))
+
+
 def test_speculative_generate_rejects_batch(devices):
     from rocket_tpu.models.generate import speculative_generate
     from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
@@ -748,6 +808,10 @@ def test_speculative_generate_rejects_batch(devices):
     )
     with pytest.raises(ValueError, match="batch=1"):
         speculative_generate(model, params, model, params, prompt, 4)
+    one = prompt[:1]
+    with pytest.raises(ValueError, match="n_draft"):
+        speculative_generate(model, params, model, params, one, 4,
+                             n_draft=0)
 
 
 def test_generate_sampling_shapes_and_jit(devices):
