@@ -143,6 +143,19 @@ def main():
           f"target forwards for {args.new_tokens} tokens "
           f"(acceptance {rate:.0%})")
 
+    # sampled flavor: rejection-based, emitted tokens exactly
+    # target-distributed whatever the draft proposes
+    from rocket_tpu.models.generate import speculative_sample
+
+    _, sstats = speculative_sample(
+        model, params, qmodel, qparams, one,
+        max_new_tokens=args.new_tokens, n_draft=4, temperature=0.8,
+        seed=0, return_stats=True,
+    )
+    srate = sstats["accepted"] / max(sstats["drafted"], 1)
+    print(f"speculative sampling (T=0.8): {args.new_tokens} tokens in "
+          f"{sstats['rounds']} target forwards (acceptance {srate:.0%})")
+
 
 if __name__ == "__main__":
     main()
