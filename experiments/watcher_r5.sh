@@ -1,0 +1,53 @@
+#!/bin/bash
+# Round-5 TPU watcher (axon tunnel is intermittent — see docs/performance.md).
+#
+# Probes the tunnel every 120s with a bounded subprocess; while it answers,
+# drains experiments/r5_queue.txt one command at a time (highest-value items
+# first — the tunnel can drop mid-queue).  Each finished item moves to
+# experiments/r5_done.txt; a failed item gets ONE retry (re-queued at the
+# end with a RETRY: prefix), then is dropped with a FAIL marker.  All output
+# lands in experiments/r5_watcher.log; bench commands additionally persist
+# their own records to experiments/bench_runs.jsonl.
+#
+# The queue file can be appended to while the watcher runs.
+cd /root/repo || exit 1
+QUEUE=experiments/r5_queue.txt
+LOG=experiments/r5_watcher.log
+DONE=experiments/r5_done.txt
+ITEM_TIMEOUT=${ITEM_TIMEOUT:-2700}
+
+stamp() { date -u +%FT%TZ; }
+
+probe() {
+  timeout 120 python -c "import jax; assert jax.devices()" >/dev/null 2>&1
+}
+
+echo "[watcher] start $(stamp) pid=$$" >> "$LOG"
+while true; do
+  ITEM=$(head -n 1 "$QUEUE" 2>/dev/null)
+  if [ -z "$ITEM" ]; then
+    echo "[watcher] queue empty $(stamp); exiting" >> "$LOG"
+    break
+  fi
+  if probe; then
+    echo "[watcher] tunnel UP $(stamp); running: $ITEM" >> "$LOG"
+    CMD=${ITEM#RETRY: }
+    timeout "$ITEM_TIMEOUT" bash -c "$CMD" >> "$LOG" 2>&1
+    rc=$?
+    echo "[watcher] rc=$rc $(stamp) for: $ITEM" >> "$LOG"
+    # pop the head (the queue may have grown while the item ran)
+    tail -n +2 "$QUEUE" > "$QUEUE.tmp" && mv "$QUEUE.tmp" "$QUEUE"
+    if [ $rc -eq 0 ]; then
+      echo "OK   $ITEM" >> "$DONE"
+    elif [ "$ITEM" = "$CMD" ]; then
+      # first failure: one retry at the back of the queue (transient
+      # remote_compile drops are common right as the tunnel flaps)
+      echo "RETRY: $CMD" >> "$QUEUE"
+      echo "RETRYQUEUED rc=$rc $CMD" >> "$DONE"
+    else
+      echo "FAIL rc=$rc $CMD" >> "$DONE"
+    fi
+  else
+    sleep 120
+  fi
+done

@@ -189,14 +189,85 @@ def load_mnist_idx(
     )
 
 
+# The reference pulls real MNIST through torchvision's downloader
+# (/root/reference/examples/mnist.py:85-88); these mirrors serve the same
+# canonical IDX files without the torchvision dependency.
+_MNIST_MIRRORS = (
+    "https://ossci-datasets.s3.amazonaws.com/mnist/",
+    "https://storage.googleapis.com/cvdf-datasets/mnist/",
+)
+_MNIST_FILES = (
+    "train-images-idx3-ubyte.gz",
+    "train-labels-idx1-ubyte.gz",
+    "t10k-images-idx3-ubyte.gz",
+    "t10k-labels-idx1-ubyte.gz",
+)
+
+
+def _has_mnist_idx(directory: str) -> bool:
+    return all(
+        os.path.exists(os.path.join(directory, f))
+        or os.path.exists(os.path.join(directory, f[: -len(".gz")]))
+        for f in _MNIST_FILES
+    )
+
+
+def download_mnist(directory: str, timeout: float = 30.0) -> bool:
+    """Best-effort download of the four MNIST IDX files into
+    ``directory`` (atomic ``.part`` rename, existing files kept).
+    Returns True when all four are present afterwards; any network
+    failure just returns False — callers fall back to synthetic data,
+    so an air-gapped machine degrades instead of dying."""
+    import http.client
+    import shutil
+    import urllib.error
+    import urllib.request
+
+    os.makedirs(directory, exist_ok=True)
+    for fname in _MNIST_FILES:
+        dest = os.path.join(directory, fname)
+        if os.path.exists(dest) or os.path.exists(dest[: -len(".gz")]):
+            continue
+        for mirror in _MNIST_MIRRORS:
+            part = dest + ".part"
+            try:
+                with urllib.request.urlopen(
+                    mirror + fname, timeout=timeout
+                ) as resp, open(part, "wb") as out:
+                    shutil.copyfileobj(resp, out)
+                os.replace(part, dest)
+                break
+            # HTTPException covers mid-transfer drops (IncompleteRead),
+            # which subclass neither OSError nor URLError
+            except (OSError, urllib.error.URLError,
+                    http.client.HTTPException, ValueError):
+                pass
+            finally:
+                if os.path.exists(part):
+                    os.remove(part)
+        else:
+            return False
+    return _has_mnist_idx(directory)
+
+
 def mnist(
-    data_dir: Optional[str] = None, **synthetic_kwargs
+    data_dir: Optional[str] = None,
+    download: Optional[bool] = None,
+    **synthetic_kwargs,
 ) -> Tuple[Dict[str, np.ndarray], Dict[str, np.ndarray]]:
     """Real MNIST when ``data_dir`` (or ``$MNIST_DIR``) holds the IDX files;
-    synthetic otherwise."""
+    synthetic otherwise.  ``download=True`` (or ``$MNIST_DOWNLOAD=1``)
+    additionally tries :func:`download_mnist` into ``data_dir`` first —
+    parity with the reference's torchvision auto-download, minus the
+    hard network dependency."""
     data_dir = data_dir or os.environ.get("MNIST_DIR")
-    if data_dir and os.path.isdir(data_dir):
-        return load_mnist_idx(data_dir)
+    if download is None:
+        download = bool(int(os.environ.get("MNIST_DOWNLOAD", "0")))
+    if data_dir:
+        if download and not _has_mnist_idx(data_dir):
+            download_mnist(data_dir)
+        if _has_mnist_idx(data_dir):
+            return load_mnist_idx(data_dir)
     return synthetic_mnist(**synthetic_kwargs)
 
 

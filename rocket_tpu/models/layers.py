@@ -136,6 +136,10 @@ class Embed(nn.Module):
     # Inference-only: int8 table + per-vocab-row scale. The row scale
     # serves both directions of tying — rows are the output channels of
     # ``attend`` (the LM head) and the units of the token gather.
+    # dtype=None resolves to bf16 on this path (there is no float table
+    # whose dtype could serve as "its own" — int8 weights exist FOR the
+    # bf16 decode pipeline); pass dtype=f32 explicitly to keep an
+    # f32-compute residual stream.
     weights_int8: bool = False
 
     def setup(self):
@@ -216,8 +220,18 @@ class Embed(nn.Module):
 
     def attend(self, x):
         if self.weights_int8:
-            from rocket_tpu.ops.quant import int8_matmul
+            from rocket_tpu.ops.quant import dequantize_int8, int8_matmul
 
+            if self._vocab_sharded():
+                # mirror __call__: a vocab-sharded table cannot feed the
+                # pallas kernel (pallas_call won't partition over the
+                # sharded vocab rows) — dequant + einsum lets GSPMD
+                # shard the LM-head matmul instead (ADVICE r4)
+                table = dequantize_int8(
+                    self.embedding_q, self.embedding_scale, axis=1,
+                    dtype=x.dtype,
+                )
+                return jnp.einsum("...d,vd->...v", x, table)
             # nk_layout: the table's natural [vocab, embed] IS [N, K]
             return int8_matmul(
                 x, self.embedding_q, self.embedding_scale, nk_layout=True

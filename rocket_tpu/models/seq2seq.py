@@ -74,8 +74,9 @@ class Seq2SeqConfig:
     use_bias: bool = False
     norm_eps: float = 1e-5
     attention: str = "auto"
-    attention_block_q: int = 256
-    attention_block_k: int = 512
+    # None = shape-aware measured flash tiling (ops.flash.auto_blocks)
+    attention_block_q: Optional[int] = None
+    attention_block_k: Optional[int] = None
     fused_qkv: bool = False
     # Logits-free decoder loss (same machinery as TransformerLM.fused_ce):
     # __call__ emits batch['token_nll']/'token_lse' instead of logits; the

@@ -68,8 +68,11 @@ class TransformerConfig:
     use_bias: bool = False
     norm_eps: float = 1e-5
     attention: str = "auto"  # 'auto' | 'dot' | 'flash' | 'ring'
-    attention_block_q: int = 256
-    attention_block_k: int = 512
+    # None = shape-aware measured-best flash tiling (ops.flash.auto_blocks:
+    # 512/1024 at S>=1024, shrinking with S) — the round-4 silicon sweep's
+    # optimum, now the library default rather than a bench-only tune.
+    attention_block_q: Optional[int] = None
+    attention_block_k: Optional[int] = None
     # One [hidden, (H+2*KV)*D] projection instead of three separate q/k/v
     # matmuls — at GPT-2 width the MXU prefers the single wider matmul.
     # Changes the param tree (attn/qkv vs attn/{q,k,v}), so it is opt-in.
@@ -88,6 +91,16 @@ class TransformerConfig:
     fused_ce: bool = False
     # Tokens per fused-CE chunk; peak transient memory is chunk * vocab f32.
     fused_ce_chunk: int = 1024
+    # Per-row KV-cache frontiers for decode: cache writes and the causal
+    # mask derive from the caller's ``positions`` (first column = each
+    # row's write offset) instead of the shared scalar ``cache_index``.
+    # Batched speculative decoding needs this — rows accept different
+    # draft counts, so their frontiers diverge.  Off by default: the
+    # uniform-frontier path lowers to ONE dynamic_update_slice (the
+    # measured decode-bench path) where per-row writes become a vmapped
+    # scatter.  The param tree and cache shapes are identical either
+    # way, so the same params/cache work under both settings.
+    decode_per_row: bool = False
     causal: bool = True  # False -> bidirectional encoder (ViT)
     remat: bool = False
     # Rematerialization policy (remat=True): what the checkpointed block
@@ -279,7 +292,7 @@ class Attention(nn.Module):
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
         if decode:
-            out = self._decode_attend(q, k, v)
+            out = self._decode_attend(q, k, v, positions)
         else:
             out = attend(
                 q,
@@ -305,10 +318,18 @@ class Attention(nn.Module):
             out = nn.Dropout(cfg.dropout, deterministic=False)(out)
         return out
 
-    def _decode_attend(self, q, k, v):
+    def _decode_attend(self, q, k, v, positions):
         """KV-cache attention for autoregressive decode (the standard flax
         ``cache`` collection pattern): new K/V are written at the cache
-        frontier, q attends against everything written so far."""
+        frontier, q attends against everything written so far.
+
+        With ``config.decode_per_row`` the write offset and causal mask
+        come from ``positions[:, 0]`` per row (positions must be
+        contiguous per row — every caller in ``models.generate`` builds
+        them as ``start + arange(S)``).  Stale cache slots past a row's
+        frontier need no rewind: their key positions exceed every live
+        query position, so the causal mask hides them until a later
+        chunk overwrites them in place."""
         from rocket_tpu.ops.attention import dot_attention
 
         cfg = self.config
@@ -327,12 +348,29 @@ class Attention(nn.Module):
             # init pass: create the cache shapes, attend normally
             return attend(q, k, v, impl="dot", causal=cfg.causal)
         idx = cache_index.value
-        k_all = jax.lax.dynamic_update_slice(cached_k.value, k, (0, idx, 0, 0))
-        v_all = jax.lax.dynamic_update_slice(cached_v.value, v, (0, idx, 0, 0))
+        if cfg.decode_per_row:
+            starts = positions[:, 0].astype(jnp.int32)
+            row_write = jax.vmap(
+                lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0))
+            )
+            k_all = row_write(cached_k.value, k, starts)
+            v_all = row_write(cached_v.value, v, starts)
+            q_off = starts
+            # scalar cache_index is bookkeeping only in this mode (rows
+            # advance independently); track the furthest write frontier
+            cache_index.value = jnp.max(starts) + S
+        else:
+            k_all = jax.lax.dynamic_update_slice(
+                cached_k.value, k, (0, idx, 0, 0)
+            )
+            v_all = jax.lax.dynamic_update_slice(
+                cached_v.value, v, (0, idx, 0, 0)
+            )
+            q_off = idx
+            cache_index.value = idx + S
         cached_k.value = k_all
         cached_v.value = v_all
-        cache_index.value = idx + S
-        return dot_attention(q, k_all, v_all, causal=True, q_offset=idx)
+        return dot_attention(q, k_all, v_all, causal=True, q_offset=q_off)
 
 
 class MLP(nn.Module):

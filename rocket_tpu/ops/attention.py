@@ -58,7 +58,9 @@ def dot_attention(
     ``q_offset`` positions the queries at ``q_offset .. q_offset+S-1``
     within the key axis — the KV-cache decode case, where K/V span the
     whole cache (``[B, T, KV, D]``, zeros past the write frontier masked
-    out causally) while q holds only the newest token(s).
+    out causally) while q holds only the newest token(s).  A ``[B]``
+    array gives each row its OWN offset (batched speculative decode:
+    rows sit at different frontiers); a scalar applies to all rows.
 
     ``kv_mask`` (``[B, S_k]``, 1 = attend) is a key-only padding mask —
     the cross-attention case (q and k come from different sequences, so
@@ -75,12 +77,18 @@ def dot_attention(
     logits = logits * scale
     neg = jnp.asarray(-0.7 * jnp.finfo(jnp.float32).max, logits.dtype)
     if causal:
-        q_pos = jnp.arange(S)[:, None]
-        if q_offset is not None:
-            q_pos = q_pos + q_offset
-        k_pos = jnp.arange(k.shape[1])[None, :]
-        mask = q_pos >= k_pos
-        logits = jnp.where(mask[None, None], logits, neg)
+        k_pos = jnp.arange(k.shape[1])
+        if q_offset is not None and jnp.ndim(q_offset) == 1:
+            # per-row offsets: mask is [B, S, K], broadcast over heads
+            q_pos = jnp.arange(S)[None, :] + q_offset[:, None]
+            mask = q_pos[:, :, None] >= k_pos[None, None, :]
+            logits = jnp.where(mask[:, None], logits, neg)
+        else:
+            q_pos = jnp.arange(S)[:, None]
+            if q_offset is not None:
+                q_pos = q_pos + q_offset
+            mask = q_pos >= k_pos[None, :]
+            logits = jnp.where(mask[None, None], logits, neg)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
         logits = jnp.where(seg_mask[:, None], logits, neg)
@@ -100,14 +108,16 @@ def attend(
     segment_ids: Optional[Array] = None,
     scale: Optional[float] = None,
     seq_axis: Optional[str] = None,
-    block_q: int = 256,
-    block_k: int = 512,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
 ) -> Array:
     """Dispatch to an attention implementation.
 
     ``impl='auto'``: flash on TPU (falls back to dot where the kernel's
     tiling constraints aren't met), dot elsewhere. ``impl='ring'`` requires
     an active mesh context with a non-trivial ``seq`` axis.
+    ``block_q``/``block_k`` = None uses the flash kernel's shape-aware
+    measured defaults (``ops.flash.auto_blocks``).
     """
     if impl == "auto":
         impl = "flash" if q.shape[1] >= 128 and _on_tpu() else "dot"

@@ -1359,3 +1359,120 @@ class TestBeamSearch:
         )
         toks = np.asarray(tokens)[0]
         assert toks[1] == eos and np.all(toks[2:] == 0), toks
+
+
+def _spec_batched_setup(seed=0, B=4, P=8, vocab=64, draft_differs=True):
+    """Tiny target+draft pair for the batched speculative tests.
+
+    max_seq carries the n_draft slack speculative_generate_batched
+    requires (the verify chunk writes past a nearly-finished row)."""
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=vocab, hidden=32, n_layers=2, n_heads=4, max_seq=64,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+    )
+    draft_cfg = TransformerConfig(
+        vocab_size=vocab, hidden=16, n_layers=1, n_heads=2, max_seq=64,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+    ) if draft_differs else cfg
+    prompt = jnp.asarray(
+        np.random.default_rng(seed).integers(0, vocab, size=(B, P)),
+        jnp.int32,
+    )
+    model, draft = TransformerLM(cfg), TransformerLM(draft_cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+    draft_params = params if not draft_differs else nn.meta.unbox(
+        draft.init(jax.random.PRNGKey(2), {"tokens": prompt})["params"]
+    )
+    return model, params, draft, draft_params, prompt
+
+
+def test_speculative_batched_matches_plain_greedy(devices):
+    """The batched device-side decoder carries the same exactness
+    contract as the host loop — every row of a B>1 batch must equal
+    plain greedy decoding, whatever the (disagreeing) draft proposes
+    and however unevenly rows accept."""
+    from rocket_tpu.models.generate import (
+        generate, speculative_generate_batched)
+
+    model, params, draft, draft_params, prompt = _spec_batched_setup(B=8)
+    want = np.asarray(
+        generate(model, params, prompt, max_new_tokens=17, temperature=0.0)
+    )
+    for n_draft in (1, 3, 4):
+        got, stats = speculative_generate_batched(
+            model, params, draft, draft_params, prompt,
+            max_new_tokens=17, n_draft=n_draft, return_stats=True,
+        )
+        np.testing.assert_array_equal(np.asarray(got), want)
+        assert stats["drafted"].shape == (8,)
+        assert np.all(stats["accepted"] <= stats["drafted"])
+
+
+def test_speculative_batched_perfect_draft(devices):
+    """Target drafting for itself accepts every proposal in every round
+    — catches per-row cache corruption that output exactness alone
+    cannot (the target re-verifies everything)."""
+    from rocket_tpu.models.generate import (
+        generate, speculative_generate_batched)
+
+    model, params, _, _, prompt = _spec_batched_setup(
+        B=4, draft_differs=False)
+    want = np.asarray(
+        generate(model, params, prompt, max_new_tokens=12, temperature=0.0)
+    )
+    got, stats = speculative_generate_batched(
+        model, params, model, params, prompt, max_new_tokens=12,
+        n_draft=4, return_stats=True,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)
+    assert np.array_equal(stats["accepted"], stats["drafted"]), stats
+    # 11 post-prefill tokens at 5 per round -> exactly 3 rounds, no row
+    # should drag the others further
+    assert stats["rounds"] == 3, stats
+
+
+def test_speculative_batched_eos_matches_generate_eos(devices):
+    """Per-row eos freezing: rows hit eos at different steps; each must
+    match generate()'s fixed-length eos contract exactly."""
+    from rocket_tpu.models.generate import (
+        generate, speculative_generate_batched)
+
+    model, params, draft, draft_params, prompt = _spec_batched_setup(B=8)
+    free = np.asarray(
+        generate(model, params, prompt, max_new_tokens=16, temperature=0.0)
+    )
+    # pick an eos some rows actually emit mid-stream (row 0's 4th token)
+    eos = int(free[0, 8 + 3])
+    want = np.asarray(
+        generate(model, params, prompt, max_new_tokens=16, temperature=0.0,
+                 eos_token=eos)
+    )
+    got = np.asarray(speculative_generate_batched(
+        model, params, draft, draft_params, prompt, max_new_tokens=16,
+        n_draft=4, eos_token=eos,
+    ))
+    np.testing.assert_array_equal(got, want)
+    assert np.any(got[0, 8:] == eos)
+
+
+def test_speculative_batched_validation(devices):
+    from rocket_tpu.models.generate import speculative_generate_batched
+
+    model, params, draft, draft_params, prompt = _spec_batched_setup(B=2)
+    with pytest.raises(ValueError, match="n_draft"):
+        speculative_generate_batched(
+            model, params, draft, draft_params, prompt, 4, n_draft=0)
+    with pytest.raises(ValueError, match="max_new_tokens"):
+        speculative_generate_batched(
+            model, params, draft, draft_params, prompt, 0)
+    # max_seq=64, P=8: max_new 53 + n_draft 4 > 64 - the slack must be
+    # rejected loudly, not clamp-corrupt the cache
+    with pytest.raises(ValueError, match="max_seq"):
+        speculative_generate_batched(
+            model, params, draft, draft_params, prompt, 53, n_draft=4)

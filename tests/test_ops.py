@@ -343,3 +343,19 @@ def test_long_context_16k_ring_training_step(devices):
     )
     assert proc.returncode == 0, (proc.stdout or "") + (proc.stderr or "")
     assert "long-context-ok" in proc.stdout
+
+
+def test_auto_blocks_shape_aware_defaults():
+    """Library defaults encode the measured-best tiling (VERDICT r4 #5)
+    without rerouting irregular flash-eligible shapes to dot: S=197
+    (ViT-B/16) must keep its single-S-block kernel path."""
+    from rocket_tpu.ops.flash import auto_blocks
+
+    assert auto_blocks(1024) == (512, 1024)  # the measured GPT-2 best
+    assert auto_blocks(2048) == (512, 1024)
+    assert auto_blocks(8192) == (512, 1024)
+    assert auto_blocks(512) == (512, 512)
+    assert auto_blocks(256) == (256, 256)
+    assert auto_blocks(128) == (128, 128)
+    assert auto_blocks(197) == (197, 197)   # ViT: one S-sized block
+    assert auto_blocks(768) == (256, 256)

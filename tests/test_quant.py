@@ -300,3 +300,32 @@ def test_quantize_params_rejects_stacked_kernels(devices):
     w = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32))
     with pytest.raises(ValueError, match="scan_layers"):
         quantize_params({"blocks": {"mlp": {"kernel": w}}})
+
+
+def test_int8_embed_attend_vocab_sharded_dequant_path(devices):
+    """Embed.attend under a vocab-sharding mesh must mirror __call__'s
+    _vocab_sharded() routing (ADVICE r4): dequant + einsum (GSPMD can
+    shard the LM-head matmul) instead of the pallas int8 kernel, and the
+    values must match the unsharded kernel path."""
+    import flax.linen as nn
+
+    from rocket_tpu.models.layers import Embed
+    from rocket_tpu.parallel.context import mesh_context
+    from rocket_tpu.parallel.mesh import MeshSpec
+
+    embed = Embed(32, 16, weights_int8=True)
+    w = jax.random.normal(jax.random.PRNGKey(1), (32, 16))
+    q, s = quantize_int8(w, axis=1)
+    params = {"embedding_q": q, "embedding_scale": s}
+    x = jnp.asarray(
+        np.random.default_rng(5).normal(size=(2, 4, 16)), jnp.bfloat16
+    )
+    plain = embed.apply({"params": params}, x, method="attend")
+    mesh = MeshSpec(tensor=2, data=4).build(jax.devices())
+    with mesh_context(mesh):
+        sharded = embed.apply({"params": params}, x, method="attend")
+    assert sharded.shape == (2, 4, 32)
+    np.testing.assert_allclose(
+        np.asarray(plain, np.float32), np.asarray(sharded, np.float32),
+        rtol=1e-2, atol=1e-2,
+    )
