@@ -148,6 +148,21 @@ def main():
                 "rounds": int(stats_d["rounds"]),
                 "speedup_vs_generate": round(base / td, 3)})
 
+    # batched speculative SAMPLING at T=0.8 (no exactness assert —
+    # randomness differs from generate; acceptance is the story)
+    from rocket_tpu.models.generate import speculative_sample_batched
+
+    def dev_sample():
+        return speculative_sample_batched(
+            model, params, qmodel, qparams, prompt8, NEW, n_draft=NDRAFT,
+            temperature=0.8, rng=jax.random.PRNGKey(0), return_stats=True)
+    ts, (toks_s, stats_s) = timeit(dev_sample)
+    acc = stats_s["accepted"].sum() / max(stats_s["drafted"].sum(), 1)
+    report("spec-sample-batched-b8-T0.8", ts, 8,
+           {"acceptance": round(float(acc), 3),
+            "rounds": int(stats_s["rounds"]),
+            "speedup_vs_generate": round(t8 / ts, 3)})
+
 
 if __name__ == "__main__":
     main()

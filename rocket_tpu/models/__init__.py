@@ -5,7 +5,9 @@ from rocket_tpu.models.generate import (
     generate,
     generate_seq2seq,
     speculative_generate,
+    speculative_generate_batched,
     speculative_sample,
+    speculative_sample_batched,
 )
 from rocket_tpu.models.lenet import LeNet
 from rocket_tpu.models.lora import freeze_non_lora, freeze_where, is_lora, lora_labels, merge_lora
@@ -20,7 +22,9 @@ __all__ = [
     "generate",
     "generate_seq2seq",
     "speculative_generate",
+    "speculative_generate_batched",
     "speculative_sample",
+    "speculative_sample_batched",
     "EncoderDecoder",
     "LeNet",
     "PDense",

@@ -399,16 +399,56 @@ def speculative_generate(
     )
 
 
+def _accept_resample_rows(p_rows: jax.Array, q_rows: jax.Array,
+                          drafts: jax.Array, key: jax.Array):
+    """Vectorized speculative-sampling accept/resample (the device-side
+    counterpart of :func:`_accept_resample`; same math, one batch at a
+    time).  ``p_rows`` ``[B, k+1, V]`` target distributions, ``q_rows``
+    ``[B, k, V]`` draft distributions, ``drafts`` ``[B, k]`` proposals.
+    Returns ``(j [B], tok [B])``: accepted-prefix length per row and the
+    round's final emitted token — a residual resample from
+    ``max(0, p - q)`` at the first rejection, or a bonus draw from
+    ``p_rows[:, k]`` when everything is accepted.  Emitted tokens are
+    distributed exactly per the target ``p`` whatever ``q`` is
+    (distributionally tested against the host version)."""
+    B, k1, V = p_rows.shape
+    k = k1 - 1
+    ku, kr = jax.random.split(key)
+    u = jax.random.uniform(ku, (B, k), jnp.float32)
+    p_d = jnp.take_along_axis(p_rows[:, :k], drafts[..., None], -1)[..., 0]
+    q_d = jnp.take_along_axis(q_rows, drafts[..., None], -1)[..., 0]
+    # accept d_i iff u < min(1, p/q)  <=>  u * q < p (q > 0 for a token
+    # that was actually sampled from q; numeric zero -> reject)
+    accept = (q_d > 0.0) & (u * q_d < p_d)
+    j = jnp.cumprod(accept.astype(jnp.int32), axis=1).sum(axis=1)  # [B]
+    p_j = jnp.take_along_axis(p_rows, j[:, None, None], 1)[:, 0]   # [B, V]
+    q_pad = jnp.concatenate(  # row j==k pairs with q=0 -> residual = p_k
+        [q_rows, jnp.zeros((B, 1, V), q_rows.dtype)], axis=1)
+    q_j = jnp.take_along_axis(q_pad, j[:, None, None], 1)[:, 0]
+    residual = jnp.clip(p_j - q_j, 0.0, None)
+    total = residual.sum(-1, keepdims=True)
+    probs = jnp.where(total > 0.0, residual, p_j)  # degenerate: back to p
+    tok = jax.random.categorical(kr, jnp.log(probs), axis=-1)
+    return j, tok.astype(jnp.int32)
+
+
 @functools.partial(
     jax.jit, static_argnums=(0, 1),
-    static_argnames=("max_new_tokens", "n_draft", "eos_token"),
+    static_argnames=("max_new_tokens", "n_draft", "eos_token", "sampled"),
 )
-def _spec_batched_run(model, draft_model, params, draft_params, prompt, *,
-                      max_new_tokens, n_draft, eos_token):
+def _spec_batched_run(model, draft_model, params, draft_params, prompt,
+                      key=None, temperature=0.0, *, max_new_tokens,
+                      n_draft, eos_token, sampled=False):
     """The device-resident round loop behind
-    :func:`speculative_generate_batched` — one ``lax.while_loop``, zero
-    host syncs until the final result.  ``model``/``draft_model`` must
-    be ``decode_per_row`` variants (rows keep independent frontiers).
+    :func:`speculative_generate_batched` (``sampled=False``: greedy,
+    draft-agreement acceptance) and :func:`speculative_sample_batched`
+    (``sampled=True``: rejection sampling via
+    :func:`_accept_resample_rows`) — one ``lax.while_loop``, zero host
+    syncs until the final result.  ``model``/``draft_model`` must be
+    ``decode_per_row`` variants (rows keep independent frontiers).
+    Only the boolean mode is a static (recompiling) argument;
+    ``temperature`` is a traced operand so per-request temperatures
+    reuse one compiled executable.
 
     Why no cache rewinds: with per-row positions, a stale K/V slot past
     a row's frontier has a key position larger than every live query
@@ -419,6 +459,8 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt, *,
     B, P = prompt.shape
     total = P + max_new_tokens
     k = n_draft
+    if key is None:
+        key = jax.random.PRNGKey(0)
 
     # prefill both models over the prompt (uniform frontiers: all rows 0)
     cache_t = zero_cache(model, params, prompt)
@@ -430,7 +472,13 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt, *,
         decode=True, mutable=["cache"],
     )
     cache_t = mut["cache"]
-    g = jnp.argmax(out["logits"][:, -1], axis=-1).astype(jnp.int32)
+    last = out["logits"][:, -1].astype(jnp.float32)
+    if sampled:
+        key, kg = jax.random.split(key)
+        g = jax.random.categorical(
+            kg, last / temperature, axis=-1).astype(jnp.int32)
+    else:
+        g = jnp.argmax(last, axis=-1).astype(jnp.int32)
     _, mut = draft_model.apply(
         {"params": draft_params, "cache": cache_d},
         {"tokens": prompt, "positions": positions},
@@ -453,8 +501,9 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt, *,
         return ~jnp.all(state[2])
 
     def body(state):
-        buf, n_tok, done_in, cache_t, cache_d, (rounds, drafted, accepted) \
-            = state
+        (buf, n_tok, done_in, cache_t, cache_d, key_in,
+         (rounds, drafted, accepted)) = state
+        key_draft, key_accept, key_out = jax.random.split(key_in, 3)
         pos = n_tok - 1                                     # [B] frontiers
         pending = jnp.take_along_axis(buf, pos[:, None], axis=1)[:, 0]
 
@@ -462,21 +511,31 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt, *,
         # Step i processes chunk token C_i at position pos+i and proposes
         # C_{i+1}; the extra (k+1)-th step exists so the draft cache
         # always covers the whole chunk — no catch-up feed next round.
-        def draft_step(carry, i):
+        def draft_step(carry, xs):
             cache_d, tok = carry
+            i, ki = xs
             out, mut = draft_model.apply(
                 {"params": draft_params, "cache": cache_d},
                 {"tokens": tok[:, None], "positions": (pos + i)[:, None]},
                 decode=True, mutable=["cache"],
             )
-            nxt = jnp.argmax(out["logits"][:, 0], axis=-1).astype(jnp.int32)
-            return (mut["cache"], nxt), tok
+            logits = out["logits"][:, 0].astype(jnp.float32)
+            if sampled:
+                nxt = jax.random.categorical(
+                    ki, logits / temperature, axis=-1).astype(jnp.int32)
+                q_row = jax.nn.softmax(logits / temperature, axis=-1)
+            else:
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                q_row = jnp.zeros((B, 0), jnp.float32)  # unused
+            return (mut["cache"], nxt), (tok, q_row)
 
-        (cache_d, _), chunk_t = jax.lax.scan(
+        (cache_d, _), (chunk_t, q_t) = jax.lax.scan(
             draft_step, (cache_d, pending),
-            jnp.arange(k + 1, dtype=jnp.int32),
+            (jnp.arange(k + 1, dtype=jnp.int32),
+             jax.random.split(key_draft, k + 1)),
         )
         chunk = chunk_t.swapaxes(0, 1)        # [B, k+1]: [pending, d_1..d_k]
+        drafts = chunk[:, 1:]                 # [B, k]
 
         # ONE target forward verifies every row's whole chunk
         out, mut = model.apply(
@@ -485,32 +544,49 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt, *,
             decode=True, mutable=["cache"],
         )
         cache_t = mut["cache"]
-        y = jnp.argmax(out["logits"], axis=-1).astype(jnp.int32)  # [B, k+1]
+        t_logits = out["logits"].astype(jnp.float32)        # [B, k+1, V]
 
-        # leading agreement: j accepted drafts per row.  The accepted
-        # drafts ARE the target's own argmaxes, so each row's new tokens
-        # are simply y[:, :j+1] (bonus/correction token included).
-        match = (chunk[:, 1:] == y[:, :k]).astype(jnp.int32)
-        j = jnp.cumprod(match, axis=1).sum(axis=1)          # [B], 0..k
+        if sampled:
+            # rejection sampling: accept d_i with prob min(1, p/q); the
+            # emitted tokens are the accepted DRAFTS plus the round's
+            # resample/bonus draw
+            p_rows = jax.nn.softmax(t_logits / temperature, axis=-1)
+            q_rows = q_t[:k].swapaxes(0, 1)                 # [B, k, V]
+            j, tok = _accept_resample_rows(
+                p_rows, q_rows, drafts, key_accept)
+            vals = jnp.where(
+                ar < j[:, None],
+                jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
+                tok[:, None],
+            )
+        else:
+            # greedy: leading draft/argmax agreement; the accepted drafts
+            # ARE the target's own argmaxes, so each row's new tokens are
+            # simply y[:, :j+1] (bonus/correction token included)
+            y = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+            match = (drafts == y[:, :k]).astype(jnp.int32)
+            j = jnp.cumprod(match, axis=1).sum(axis=1)      # [B], 0..k
+            vals = y
+
         keep = ar <= j[:, None]
         if eos_token is not None:
             # freeze at the first emitted eos: keep through it, drop after
             no_eos_before = jnp.cumprod(jnp.concatenate(
                 [jnp.ones((B, 1), jnp.int32),
-                 (y[:, :k] != eos_token).astype(jnp.int32)], axis=1,
+                 (vals[:, :k] != eos_token).astype(jnp.int32)], axis=1,
             ), axis=1).astype(bool)
             keep = keep & no_eos_before
         keep = keep & ((n_tok[:, None] + ar) < total) & ~done_in[:, None]
 
         cols = jnp.where(keep, n_tok[:, None] + ar, total)  # OOB -> dropped
         rows = jnp.broadcast_to(jnp.arange(B)[:, None], cols.shape)
-        buf = buf.at[rows, cols].set(y, mode="drop")
+        buf = buf.at[rows, cols].set(vals, mode="drop")
 
         acc = keep.sum(axis=1).astype(jnp.int32)
         n_tok = n_tok + acc
         done = done_in | (n_tok >= total)
         if eos_token is not None:
-            done = done | jnp.any((y == eos_token) & keep, axis=1)
+            done = done | jnp.any((vals == eos_token) & keep, axis=1)
         active = ~done_in
         # Stats mirror the host loop's semantics: drafted clamps to the
         # row's remaining token budget (the B=1 loop shortens its last
@@ -522,10 +598,10 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt, *,
         stats = (rounds + 1,
                  drafted + jnp.where(active, jnp.minimum(k, remaining), 0),
                  accepted + jnp.where(active, jnp.minimum(j, acc), 0))
-        return buf, n_tok, done, cache_t, cache_d, stats
+        return buf, n_tok, done, cache_t, cache_d, key_out, stats
 
-    buf, n_tok, done, _, _, stats = jax.lax.while_loop(
-        cond, body, (buf, n_tok, done, cache_t, cache_d, stats0)
+    buf, n_tok, done, _, _, _, stats = jax.lax.while_loop(
+        cond, body, (buf, n_tok, done, cache_t, cache_d, key, stats0)
     )
     if eos_token is not None:
         # fixed-length contract: eos-frozen rows fill their tail with eos
@@ -533,6 +609,45 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt, *,
         cols = jnp.arange(total, dtype=jnp.int32)[None, :]
         buf = jnp.where(cols >= n_tok[:, None], eos_token, buf)
     return buf, stats
+
+
+def _spec_batched_call(model, draft_model, params, draft_params, prompt,
+                       max_new_tokens, n_draft, eos_token, return_stats,
+                       key=None, temperature=0.0, sampled=False):
+    """Shared front door for both batched speculative wrappers:
+    validation (including the max_seq + n_draft slack rule), the
+    ``decode_per_row`` model variants, the run, and stats packaging —
+    one place, so the two public entry points cannot drift."""
+    import dataclasses
+
+    B, P = prompt.shape
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if n_draft < 1:
+        raise ValueError(f"n_draft must be >= 1, got {n_draft}")
+    total = P + max_new_tokens
+    for m, label in ((model, "model"), (draft_model, "draft_model")):
+        if total + n_draft > m.config.max_seq:
+            raise ValueError(
+                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) + "
+                f"n_draft ({n_draft}) = {total + n_draft} exceeds {label}'s "
+                f"max_seq ({m.config.max_seq}); the verify chunk can write "
+                f"up to n_draft slots past the final token — size max_seq "
+                f"with that slack"
+            )
+    per_row = lambda m: type(m)(  # noqa: E731
+        dataclasses.replace(m.config, decode_per_row=True)
+    )
+    buf, (rounds, drafted, accepted) = _spec_batched_run(
+        per_row(model), per_row(draft_model), params, draft_params, prompt,
+        key, temperature, max_new_tokens=max_new_tokens, n_draft=n_draft,
+        eos_token=eos_token, sampled=sampled,
+    )
+    if return_stats:
+        return buf, {"rounds": int(rounds),
+                     "drafted": np.asarray(drafted),
+                     "accepted": np.asarray(accepted)}
+    return buf
 
 
 def speculative_generate_batched(
@@ -573,35 +688,54 @@ def speculative_generate_batched(
     ``return_stats=True`` also ``{"rounds": int, "drafted": [B],
     "accepted": [B]}`` (per-row numpy counts).
     """
-    import dataclasses
+    return _spec_batched_call(
+        model, draft_model, params, draft_params, prompt,
+        max_new_tokens, n_draft, eos_token, return_stats,
+    )
 
-    B, P = prompt.shape
-    if max_new_tokens < 1:
-        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if n_draft < 1:
-        raise ValueError(f"n_draft must be >= 1, got {n_draft}")
-    total = P + max_new_tokens
-    for m, label in ((model, "model"), (draft_model, "draft_model")):
-        if total + n_draft > m.config.max_seq:
-            raise ValueError(
-                f"prompt ({P}) + max_new_tokens ({max_new_tokens}) + "
-                f"n_draft ({n_draft}) = {total + n_draft} exceeds {label}'s "
-                f"max_seq ({m.config.max_seq}); the verify chunk can write "
-                f"up to n_draft slots past the final token — size max_seq "
-                f"with that slack"
-            )
-    per_row = lambda m: type(m)(  # noqa: E731
-        dataclasses.replace(m.config, decode_per_row=True)
+
+def speculative_sample_batched(
+    model: Any,
+    params: Any,
+    draft_model: Any,
+    draft_params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    n_draft: int = 4,
+    temperature: float = 1.0,
+    rng: Optional[jax.Array] = None,
+    return_stats: bool = False,
+    eos_token: Optional[int] = None,
+) -> Any:
+    """Batched, device-resident speculative SAMPLING — the
+    ``temperature > 0`` counterpart of
+    :func:`speculative_generate_batched`, sharing its round loop,
+    per-row KV frontiers and max_seq slack requirement.  The draft
+    proposes from its own distribution q inside the fused scan, the
+    target verifies the chunk in one forward, and each proposal is
+    accepted with probability ``min(1, p/q)`` with a residual resample
+    on rejection (:func:`_accept_resample_rows`) — emitted tokens are
+    distributed EXACTLY per the target's sampling distribution whatever
+    the draft is.  All randomness is jax PRNG keyed by ``rng``, so a
+    fixed key gives a reproducible trace with zero host round-trips
+    (the host-loop :func:`speculative_sample` keeps numpy RNG and
+    batch=1).
+
+    Returns ``[B, P + max_new_tokens]`` tokens; with
+    ``return_stats=True`` also ``{"rounds": int, "drafted": [B],
+    "accepted": [B]}``.
+    """
+    if temperature <= 0.0:
+        raise ValueError(
+            "speculative_sample_batched needs temperature > 0; use "
+            "speculative_generate_batched for greedy decoding"
+        )
+    key = rng if rng is not None else jax.random.PRNGKey(0)
+    return _spec_batched_call(
+        model, draft_model, params, draft_params, prompt,
+        max_new_tokens, n_draft, eos_token, return_stats,
+        key=key, temperature=jnp.float32(temperature), sampled=True,
     )
-    buf, (rounds, drafted, accepted) = _spec_batched_run(
-        per_row(model), per_row(draft_model), params, draft_params, prompt,
-        max_new_tokens=max_new_tokens, n_draft=n_draft, eos_token=eos_token,
-    )
-    if return_stats:
-        return buf, {"rounds": int(rounds),
-                     "drafted": np.asarray(drafted),
-                     "accepted": np.asarray(accepted)}
-    return buf
 
 
 @functools.partial(jax.jit, static_argnums=0, static_argnames=("temperature",))

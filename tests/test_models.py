@@ -1476,3 +1476,78 @@ def test_speculative_batched_validation(devices):
     with pytest.raises(ValueError, match="max_seq"):
         speculative_generate_batched(
             model, params, draft, draft_params, prompt, 53, n_draft=4)
+
+
+def test_accept_resample_rows_marginal_matches_host_core(devices):
+    """The device-side vectorized accept/resample must realize the same
+    speculative-sampling theorem as the host core: the round's first
+    emitted token is distributed exactly per the target's p, whatever q.
+    One vectorized call over N rows replaces the host's N-trial loop."""
+    from rocket_tpu.models.generate import _accept_resample_rows
+
+    rng = np.random.default_rng(0)
+    V, k, N = 6, 2, 20_000
+    p0 = np.array([0.35, 0.05, 0.2, 0.1, 0.25, 0.05])
+    p1 = np.array([0.1, 0.3, 0.1, 0.2, 0.2, 0.1])
+    p2 = np.array([0.4, 0.1, 0.1, 0.1, 0.2, 0.1])
+    q0 = np.array([0.1, 0.4, 0.1, 0.2, 0.1, 0.1])  # very unlike p0
+    q1 = np.array([0.2, 0.2, 0.2, 0.2, 0.1, 0.1])
+    p_rows = jnp.asarray(
+        np.broadcast_to(np.stack([p0, p1, p2]), (N, k + 1, V)), jnp.float32
+    )
+    q_rows = jnp.asarray(
+        np.broadcast_to(np.stack([q0, q1]), (N, k, V)), jnp.float32
+    )
+    drafts = jnp.asarray(np.stack(
+        [rng.choice(V, size=N, p=q0), rng.choice(V, size=N, p=q1)], axis=1
+    ), jnp.int32)
+    j, tok = jax.jit(_accept_resample_rows)(
+        p_rows, q_rows, drafts, jax.random.PRNGKey(1)
+    )
+    first = np.where(np.asarray(j) >= 1, np.asarray(drafts[:, 0]),
+                     np.asarray(tok))
+    counts = np.bincount(first, minlength=V)
+    tv = 0.5 * np.abs(counts / N - p0).sum()
+    assert tv < 0.03, (tv, counts / N)
+
+
+def test_speculative_sample_batched_contracts(devices):
+    """End-to-end batched sampling: reproducible per key, in-vocab,
+    identical draft accepts everything, eos tail frozen."""
+    from rocket_tpu.models.generate import speculative_sample_batched
+
+    model, params, draft, draft_params, prompt = _spec_batched_setup(B=4)
+    out, stats = speculative_sample_batched(
+        model, params, draft, draft_params, prompt, 12, n_draft=3,
+        temperature=0.8, rng=jax.random.PRNGKey(7), return_stats=True,
+    )
+    o = np.asarray(out)
+    assert o.shape == (4, 20) and (o >= 0).all() and (o < 64).all()
+    assert np.all(stats["accepted"] <= stats["drafted"])
+    again = speculative_sample_batched(
+        model, params, draft, draft_params, prompt, 12, n_draft=3,
+        temperature=0.8, rng=jax.random.PRNGKey(7),
+    )
+    np.testing.assert_array_equal(np.asarray(again), o)
+
+    # p == q: min(1, p/q) = 1 — every proposal accepted in every round
+    _, s2 = speculative_sample_batched(
+        model, params, model, params, prompt, 12, n_draft=4,
+        temperature=1.0, rng=jax.random.PRNGKey(3), return_stats=True,
+    )
+    assert np.array_equal(s2["accepted"], s2["drafted"]), s2
+
+    # eos: prefix through the first eos, frozen all-eos tail after
+    eos = int(o[0, 8 + 2])
+    got = np.asarray(speculative_sample_batched(
+        model, params, draft, draft_params, prompt, 12, n_draft=3,
+        temperature=0.8, rng=jax.random.PRNGKey(7), eos_token=eos,
+    ))
+    for row in range(4):
+        hits = np.nonzero(got[row, 8:] == eos)[0]
+        if hits.size:
+            assert np.all(got[row, 8 + hits[0]:] == eos)
+
+    with pytest.raises(ValueError, match="temperature"):
+        speculative_sample_batched(
+            model, params, draft, draft_params, prompt, 4, temperature=0.0)
