@@ -87,6 +87,9 @@ def _last_good_ladder() -> dict:
                 if ("sweep_point" in rec or "sweep_best" in rec
                         or rec.get("kind") == "attribution"
                         or rec.get("profiled")  # trace-overhead-skewed
+                        # CPU smoke runs persist too; never replay one
+                        # as a "last-good ON-CHIP measurement"
+                        or rec.get("device", "").lower() == "cpu"
                         or "suspect" in rec):
                     continue
                 cfg = rec.get("config")
@@ -124,7 +127,7 @@ def _emit_stale_ladder(names, reason: str) -> bool:
     return True
 
 
-def init_devices(timeout_s: float = 240.0, attempts: int = 4,
+def init_devices(timeout_s: float = None, attempts: int = None,
                  stale_names=None):
     """Bounded-time, retried backend bring-up (VERDICT r1 weakness #2).
 
@@ -140,6 +143,13 @@ def init_devices(timeout_s: float = 240.0, attempts: int = 4,
     """
     import concurrent.futures
 
+    # Env overrides exist for tests (a full default cycle is ~20 min)
+    # and for operators who want a faster fail-to-stale on known-down
+    # days; the driver's plain invocation keeps the patient defaults.
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 240.0))
+    if attempts is None:
+        attempts = int(os.environ.get("BENCH_PROBE_ATTEMPTS", 4))
     last = None
     for attempt in range(attempts):
         last = _probe_backend(timeout_s)
@@ -325,8 +335,20 @@ def bench_resnet50(n_steps, warmup):
     from rocket_tpu.models.resnet import resnet50
 
     B = int(os.environ.get("BENCH_RESNET_BATCH", 256))
+    # Image size knob: 32 = the CIFAR ladder config (3x3 stem, no
+    # maxpool); >=128 switches to the ImageNet stem and 1000 classes.
+    # CIFAR's 32x32 spatial dims shrink to 4x4 by stage 4 — a structural
+    # MXU under-fill — so the 224 point separates "framework overhead"
+    # from "these conv shapes cannot fill the MXU" in the 0.298-MFU
+    # analysis (VERDICT r4 next #2).
+    img = int(os.environ.get("BENCH_RESNET_IMAGE", 32))
+    small = img < 128
+    classes = 10 if small else 1000
+    cfg_name = "resnet50" if img == 32 else f"resnet50-img{img}"
+    flavor = "cifar" if img == 32 else (
+        f"{img}px small-stem" if small else f"imagenet-shaped {img}px")
     module = rt.Module(
-        resnet50(num_classes=10, small_images=True),
+        resnet50(num_classes=classes, small_images=small),
         capsules=[
             rt.Loss(cross_entropy(labels_key="label"), name="ce"),
             rt.Optimizer(learning_rate=1e-3),
@@ -334,15 +356,16 @@ def bench_resnet50(n_steps, warmup):
     )
     rng = np.random.default_rng(0)
     batches = [
-        {"image": jnp.asarray(rng.normal(0.5, 0.25, size=(B, 32, 32, 3)),
+        {"image": jnp.asarray(rng.normal(0.5, 0.25, size=(B, img, img, 3)),
                               jnp.float32),
-         "label": jnp.asarray(rng.integers(0, 10, size=(B,)), jnp.int32)}
+         "label": jnp.asarray(rng.integers(0, classes, size=(B,)), jnp.int32)}
         for _ in range(2)
     ]
-    rec = run_config("resnet50", module, batches, B, n_steps, warmup,
+    rec = run_config(cfg_name, module, batches, B, n_steps, warmup,
                      xla_step_flops)
     rec.update({
-        "metric": f"resnet50-cifar train throughput (1 chip, bf16, bs{B})",
+        "metric": f"resnet50-{flavor} train throughput (1 chip, bf16, "
+                  f"bs{B})",
         "unit": "samples/sec/chip",
         "flops_source": "xla cost_analysis (fwd+bwd step)",
     })
@@ -806,6 +829,10 @@ def main() -> None:
             # int8 decode records carry a different config key; re-emitting
             # the bf16 record under an int8 run would mislabel it
             stale_names = [n for n in stale_names if n != "decode"]
+        if os.environ.get("BENCH_RESNET_IMAGE", "32") != "32":
+            # same config-identity rule for the image-size knob: the
+            # cached record is the 32px CIFAR config
+            stale_names = [n for n in stale_names if n != "resnet50"]
     init_devices(stale_names=stale_names)
     if args.sweep:
         sweep_gpt2(args.steps, args.warmup)

@@ -84,3 +84,34 @@ def test_resolve_scan_guard_noop_without_scan(bench):
         t, check=lambda *a, **k: calls.append(1) or True
     )
     assert out is t and note is None and not calls
+
+
+def test_bench_emits_stale_ladder_when_backend_unreachable(tmp_path):
+    """The driver contract for tunnel-down rounds (VERDICT r4 next #7b):
+    a plain `python bench.py` whose backend probes all fail must exit 0
+    and emit the last-good measured ladder marked stale, gpt2 last —
+    not a null record."""
+    import json
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ)
+    env.update({
+        # an unknown platform makes the probe subprocesses fail fast
+        "JAX_PLATFORMS": "bogus_backend",
+        "BENCH_PROBE_TIMEOUT": "20",
+        "BENCH_PROBE_ATTEMPTS": "1",
+    })
+    env.pop("BENCH_NO_STALE", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, env=env, cwd=repo, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    recs = [json.loads(l) for l in proc.stdout.splitlines()
+            if l.startswith("{")]
+    assert recs, proc.stdout
+    assert all(r.get("stale") is True and r.get("value") for r in recs)
+    assert recs[-1]["config"] == "gpt2"  # headline record stays last
+    assert "measured_age_s" in recs[-1]
