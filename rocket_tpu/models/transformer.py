@@ -68,6 +68,12 @@ class TransformerConfig:
     use_bias: bool = False
     norm_eps: float = 1e-5
     attention: str = "auto"  # 'auto' | 'dot' | 'flash' | 'ring'
+    # Sliding-window attention (Mistral-style): each position attends to
+    # the newest `attention_window` positions only. None = full causal.
+    # Requires causal=True; the flash kernel skips out-of-window K blocks
+    # (O(S*window) work at long S) and the decode cache masks by
+    # position, so generation beyond the window works unchanged.
+    attention_window: Optional[int] = None
     # None = shape-aware measured-best flash tiling (ops.flash.auto_blocks:
     # 512/1024 at S>=1024, shrinking with S) — the round-4 silicon sweep's
     # optimum, now the library default rather than a bench-only tune.
@@ -145,6 +151,13 @@ class TransformerConfig:
                 "training loss path reading the raw embedding table — "
                 "they cannot combine"
             )
+        if self.attention_window is not None and (
+            not self.causal or self.attention_window < 1
+        ):
+            raise ValueError(
+                f"attention_window={self.attention_window} requires "
+                f"causal=True and a window >= 1"
+            )
         if self.weights_int8 and self.scan_layers:
             raise ValueError(
                 "weights_int8 requires the unrolled layer layout "
@@ -219,6 +232,26 @@ class TransformerConfig:
             n_kv_heads=32,
             ffn_dim=11008,
             max_seq=4096,
+            norm="rmsnorm",
+            mlp="swiglu",
+            positions="rope",
+            norm_eps=1e-5,
+            **kw,
+        )
+
+    @classmethod
+    def mistral_7b(cls, **kw) -> "TransformerConfig":
+        """Mistral-7B v0.1: Llama-2 architecture + GQA(8) +
+        sliding-window attention (4096)."""
+        return cls(
+            vocab_size=32000,
+            hidden=4096,
+            n_layers=32,
+            n_heads=32,
+            n_kv_heads=8,
+            ffn_dim=14336,
+            max_seq=8192,
+            attention_window=4096,
             norm="rmsnorm",
             mlp="swiglu",
             positions="rope",
@@ -303,6 +336,7 @@ class Attention(nn.Module):
                 segment_ids=segment_ids,
                 block_q=cfg.attention_block_q,
                 block_k=cfg.attention_block_k,
+                window=cfg.attention_window,
             )
         out = out.reshape(B, S, H * D)
         out = PDense(
@@ -345,8 +379,11 @@ class Attention(nn.Module):
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
         if not is_filled:
-            # init pass: create the cache shapes, attend normally
-            return attend(q, k, v, impl="dot", causal=cfg.causal)
+            # init pass: create the cache shapes, attend normally (the
+            # window still applies — a user init_with_output(decode=True)
+            # must see the same masking as every other path)
+            return attend(q, k, v, impl="dot", causal=cfg.causal,
+                          window=cfg.attention_window)
         idx = cache_index.value
         if cfg.decode_per_row:
             starts = positions[:, 0].astype(jnp.int32)
@@ -370,7 +407,8 @@ class Attention(nn.Module):
             cache_index.value = idx + S
         cached_k.value = k_all
         cached_v.value = v_all
-        return dot_attention(q, k_all, v_all, causal=True, q_offset=q_off)
+        return dot_attention(q, k_all, v_all, causal=True, q_offset=q_off,
+                             window=cfg.attention_window)
 
 
 class MLP(nn.Module):

@@ -50,6 +50,7 @@ def dot_attention(
     scale: Optional[float] = None,
     q_offset: Optional[Array] = None,
     kv_mask: Optional[Array] = None,
+    window: Optional[int] = None,
 ) -> Array:
     """Reference einsum attention. Computes logits in f32 for stability
     regardless of the compute dtype (bf16 inputs stay bf16 on the matmuls —
@@ -71,6 +72,13 @@ def dot_attention(
     instead of a batch-poisoning softmax NaN.
     """
     B, S, H, D = q.shape
+    if window is not None and (not causal or window < 1):
+        # validate at the op itself: every entry point (direct call,
+        # attend dispatch, flash fallback) must reject a window that
+        # would otherwise be silently ignored or fully mask rows
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1"
+        )
     k, v = _repeat_kv(k, v, H)
     scale = scale if scale is not None else D ** -0.5
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
@@ -82,12 +90,16 @@ def dot_attention(
             # per-row offsets: mask is [B, S, K], broadcast over heads
             q_pos = jnp.arange(S)[None, :] + q_offset[:, None]
             mask = q_pos[:, :, None] >= k_pos[None, None, :]
+            if window is not None:
+                mask &= (q_pos[:, :, None] - k_pos[None, None, :]) < window
             logits = jnp.where(mask[:, None], logits, neg)
         else:
             q_pos = jnp.arange(S)[:, None]
             if q_offset is not None:
                 q_pos = q_pos + q_offset
             mask = q_pos >= k_pos[None, :]
+            if window is not None:
+                mask &= (q_pos - k_pos[None, :]) < window
             logits = jnp.where(mask[None, None], logits, neg)
     if segment_ids is not None:
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
@@ -110,6 +122,7 @@ def attend(
     seq_axis: Optional[str] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> Array:
     """Dispatch to an attention implementation.
 
@@ -117,24 +130,33 @@ def attend(
     tiling constraints aren't met), dot elsewhere. ``impl='ring'`` requires
     an active mesh context with a non-trivial ``seq`` axis.
     ``block_q``/``block_k`` = None uses the flash kernel's shape-aware
-    measured defaults (``ops.flash.auto_blocks``).
+    measured defaults (``ops.flash.auto_blocks``).  ``window`` is
+    sliding-window attention (causal only; flash and dot — the ring
+    rotation schedule has no early-exit for windowed keys, so it is
+    rejected rather than silently doing full-causal work).
     """
     if impl == "auto":
         impl = "flash" if q.shape[1] >= 128 and _on_tpu() else "dot"
     if impl == "dot":
         return dot_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
+            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
+            window=window,
         )
     if impl == "flash":
         from rocket_tpu.ops.flash import flash_attention
 
         return flash_attention(
             q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         )
     if impl == "ring":
         from rocket_tpu.ops.ring import ring_attention
 
+        if window is not None:
+            raise ValueError(
+                "sliding-window attention is not supported under "
+                "impl='ring' (sequence parallelism); use flash/dot"
+            )
         return ring_attention(
             q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
             seq_axis=seq_axis or "seq"

@@ -62,8 +62,11 @@ def _interpret() -> bool:
 
 
 def _block_mask(causal: bool, has_seg: bool, qi, ki, sq_ref, sk_ref,
-                block_q: int, block_k: int):
-    """[bq, bk] boolean mask (True = attend) or None when unmasked."""
+                block_q: int, block_k: int, window=None):
+    """[bq, bk] boolean mask (True = attend) or None when unmasked.
+
+    ``window`` (requires ``causal``) keeps only the newest ``window``
+    positions per query — Mistral-style sliding-window attention."""
     mask = None
     if causal:
         q_pos = qi * block_q + jax.lax.broadcasted_iota(
@@ -73,6 +76,8 @@ def _block_mask(causal: bool, has_seg: bool, qi, ki, sq_ref, sk_ref,
             jnp.int32, (block_q, block_k), 1
         )
         mask = q_pos >= k_pos
+        if window is not None:
+            mask = mask & (q_pos - k_pos < window)
     if has_seg:
         sq = sq_ref[0][:, :1]  # [bq, 1] (lane-broadcast layout, lane 0)
         sk = sk_ref[0][:1, :]  # [1, bk] (sublane layout, sublane 0)
@@ -81,13 +86,28 @@ def _block_mask(causal: bool, has_seg: bool, qi, ki, sq_ref, sk_ref,
     return mask
 
 
+def _block_live(causal: bool, window, qi, ki, block_q: int, block_k: int):
+    """Whether a (qi, ki) tile can contain any attended pair: causal
+    skips tiles entirely above the diagonal; a sliding window also
+    skips tiles entirely OLDER than every query's window."""
+    run = True
+    if causal:
+        run = ki * block_k <= qi * block_q + block_q - 1
+        if window is not None:
+            run = jnp.logical_and(
+                run,
+                ki * block_k + block_k - 1 >= qi * block_q - window + 1,
+            )
+    return run
+
+
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
 
 
 def _fwd_kernel(*refs, scale: float, causal: bool, has_seg: bool,
-                block_q: int, block_k: int):
+                block_q: int, block_k: int, window=None):
     if has_seg:
         q_ref, k_ref, v_ref, sq_ref, sk_ref = refs[:5]
         o_ref, lse_ref, acc_ref, m_ref, l_ref = refs[5:]
@@ -105,10 +125,9 @@ def _fwd_kernel(*refs, scale: float, causal: bool, has_seg: bool,
         m_ref[:] = jnp.full_like(m_ref, MASK_VALUE)
         l_ref[:] = jnp.zeros_like(l_ref)
 
-    # Causal: K blocks entirely above the diagonal contribute nothing.
-    run = True
-    if causal:
-        run = ki * block_k <= qi * block_q + block_q - 1
+    # Causal: K blocks entirely above the diagonal contribute nothing;
+    # a sliding window also skips blocks entirely older than the window.
+    run = _block_live(causal, window, qi, ki, block_q, block_k)
 
     @pl.when(run)
     def _compute():
@@ -123,7 +142,7 @@ def _fwd_kernel(*refs, scale: float, causal: bool, has_seg: bool,
             preferred_element_type=jnp.float32,
         ) * scale  # [bq, bk] f32
         mask = _block_mask(causal, has_seg, qi, ki, sq_ref, sk_ref,
-                           block_q, block_k)
+                           block_q, block_k, window)
         if mask is not None:
             s = jnp.where(mask, s, MASK_VALUE)
         m_prev = m_ref[:, :1]  # [bq, 1]
@@ -178,7 +197,7 @@ def _seg_layouts(seg):
 
 
 def _flash_fwd(q, k, v, seg, causal: bool, scale: float,
-               block_q: int, block_k: int):
+               block_q: int, block_k: int, window=None):
     B, H, S, D = q.shape
     has_seg = seg is not None
     sq, sk = _seg_layouts(seg)
@@ -186,7 +205,7 @@ def _flash_fwd(q, k, v, seg, causal: bool, scale: float,
     grid = (B, H, nq, nk)
     kernel = functools.partial(
         _fwd_kernel, scale=scale, causal=causal, has_seg=has_seg,
-        block_q=block_q, block_k=block_k,
+        block_q=block_q, block_k=block_k, window=window,
     )
     in_specs = [
         pl.BlockSpec((1, 1, block_q, D), lambda b, h, qi, ki: (b, h, qi, 0)),
@@ -227,7 +246,7 @@ def _flash_fwd(q, k, v, seg, causal: bool, scale: float,
 
 
 def _dq_kernel(*refs, scale: float, causal: bool, has_seg: bool,
-               block_q: int, block_k: int):
+               block_q: int, block_k: int, window=None):
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          sq_ref, sk_ref, dq_ref, acc_ref) = refs
@@ -243,9 +262,7 @@ def _dq_kernel(*refs, scale: float, causal: bool, has_seg: bool,
     def _init():
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    run = True
-    if causal:
-        run = ki * block_k <= qi * block_q + block_q - 1
+    run = _block_live(causal, window, qi, ki, block_q, block_k)
 
     @pl.when(run)
     def _compute():
@@ -262,7 +279,7 @@ def _dq_kernel(*refs, scale: float, causal: bool, has_seg: bool,
         ) * scale
         p = jnp.exp(s - lse)
         mask = _block_mask(causal, has_seg, qi, ki, sq_ref, sk_ref,
-                           block_q, block_k)
+                           block_q, block_k, window)
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
@@ -281,7 +298,7 @@ def _dq_kernel(*refs, scale: float, causal: bool, has_seg: bool,
 
 
 def _dkv_kernel(*refs, scale: float, causal: bool, has_seg: bool,
-                block_q: int, block_k: int):
+                block_q: int, block_k: int, window=None):
     if has_seg:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          sq_ref, sk_ref, dk_ref, dv_ref, dk_acc, dv_acc) = refs
@@ -298,9 +315,7 @@ def _dkv_kernel(*refs, scale: float, causal: bool, has_seg: bool,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    run = True
-    if causal:
-        run = ki * block_k <= qi * block_q + block_q - 1
+    run = _block_live(causal, window, qi, ki, block_q, block_k)
 
     @pl.when(run)
     def _compute():
@@ -317,7 +332,7 @@ def _dkv_kernel(*refs, scale: float, causal: bool, has_seg: bool,
         ) * scale  # [bq, bk] f32
         p = jnp.exp(s - lse)
         mask = _block_mask(causal, has_seg, qi, ki, sq_ref, sk_ref,
-                           block_q, block_k)
+                           block_q, block_k, window)
         if mask is not None:
             p = jnp.where(mask, p, 0.0)
         # dV += Pᵀ dO
@@ -343,7 +358,7 @@ def _dkv_kernel(*refs, scale: float, causal: bool, has_seg: bool,
 
 
 def _flash_bwd(q, k, v, seg, o, lse, do, causal: bool, scale: float,
-               block_q: int, block_k: int):
+               block_q: int, block_k: int, window=None):
     B, H, S, D = q.shape
     has_seg = seg is not None
     sq, sk = _seg_layouts(seg)
@@ -366,7 +381,7 @@ def _flash_bwd(q, k, v, seg, o, lse, do, causal: bool, scale: float,
     dq = pl.pallas_call(
         functools.partial(
             _dq_kernel, scale=scale, causal=causal, has_seg=has_seg,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         ),
         grid=(B, H, nq, nk),
         in_specs=common_in,
@@ -393,7 +408,7 @@ def _flash_bwd(q, k, v, seg, o, lse, do, causal: bool, scale: float,
     dk, dv = pl.pallas_call(
         functools.partial(
             _dkv_kernel, scale=scale, causal=causal, has_seg=has_seg,
-            block_q=block_q, block_k=block_k,
+            block_q=block_q, block_k=block_k, window=window,
         ),
         grid=(B, H, nk, nq),
         in_specs=kv_in,
@@ -423,22 +438,25 @@ def _flash_bwd(q, k, v, seg, o, lse, do, causal: bool, scale: float,
 # never held as fwd->bwd residuals.
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
-def _flash(q, k, v, seg, causal, scale, block_q, block_k):
-    o, _ = _flash_fwd(q, k, v, seg, causal, scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q, k, v, seg, causal, scale, block_q, block_k, window):
+    o, _ = _flash_fwd(q, k, v, seg, causal, scale, block_q, block_k,
+                      window)
     return o
 
 
-def _flash_fwd_rule(q, k, v, seg, causal, scale, block_q, block_k):
-    o, lse = _flash_fwd(q, k, v, seg, causal, scale, block_q, block_k)
+def _flash_fwd_rule(q, k, v, seg, causal, scale, block_q, block_k,
+                    window):
+    o, lse = _flash_fwd(q, k, v, seg, causal, scale, block_q, block_k,
+                        window)
     return o, (q, k, v, seg, o, lse)
 
 
-def _flash_bwd_rule(causal, scale, block_q, block_k, res, g):
+def _flash_bwd_rule(causal, scale, block_q, block_k, window, res, g):
     q, k, v, seg, o, lse = res
     dq, dk, dv = _flash_bwd(
         q, k, v, seg, o, lse, g.astype(q.dtype), causal, scale,
-        block_q, block_k
+        block_q, block_k, window
     )
     dseg = None if seg is None else jnp.zeros_like(seg)
     return dq, dk, dv, dseg
@@ -462,11 +480,16 @@ def flash_attention(
     scale: Optional[float] = None,
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
+    window: Optional[int] = None,
 ) -> jax.Array:
     """Flash attention on ``[B, S, H, D]`` (K/V may be GQA-grouped).
 
     ``segment_ids`` (``[B, S]`` int) restricts attention to same-segment
     pairs — packed multi-document batches keep the O(S) blocked kernel.
+    ``window`` (requires ``causal``) is Mistral-style sliding-window
+    attention: each query sees only the newest ``window`` positions, and
+    K blocks entirely older than the window are SKIPPED — at long S the
+    kernel's work becomes O(S·window) instead of O(S²/2).
     ``block_q``/``block_k`` default to the shape-aware measured-best
     tiling (:func:`auto_blocks`); pass explicit sizes to override.
     Falls back to :func:`rocket_tpu.ops.attention.dot_attention` when the
@@ -476,13 +499,18 @@ def flash_attention(
     from rocket_tpu.ops.attention import _repeat_kv, dot_attention
 
     B, S, H, D = q.shape
+    if window is not None and (not causal or window < 1):
+        raise ValueError(
+            f"window={window} requires causal=True and window >= 1"
+        )
     scale = scale if scale is not None else D ** -0.5
     auto_q, auto_k = auto_blocks(S)
     block_q = min(block_q if block_q is not None else auto_q, S)
     block_k = min(block_k if block_k is not None else auto_k, S)
     if S % block_q != 0 or S % block_k != 0 or D % 8 != 0:
         return dot_attention(
-            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale
+            q, k, v, causal=causal, segment_ids=segment_ids, scale=scale,
+            window=window,
         )
     k, v = _repeat_kv(k, v, H)
     # The kernels run their matmuls in the input dtype (no internal f32
@@ -493,5 +521,5 @@ def flash_attention(
     seg = None if segment_ids is None else segment_ids.astype(jnp.float32)
     # [B, S, H, D] -> [B, H, S, D] for the kernel
     qt, kt, vt = (x.swapaxes(1, 2) for x in (q, k, v))
-    o = _flash(qt, kt, vt, seg, causal, scale, block_q, block_k)
+    o = _flash(qt, kt, vt, seg, causal, scale, block_q, block_k, window)
     return o.swapaxes(1, 2)

@@ -1608,3 +1608,38 @@ def test_generate_under_tensor_sharded_params(devices):
             n_draft=4,
         ))
     np.testing.assert_array_equal(spec, want)
+
+
+def test_sliding_window_decode_matches_full_forward(devices):
+    """A TransformerLM with attention_window must generate the same
+    greedy tokens through the KV-cache decode path as through repeated
+    full (train-path) forwards — generation beyond the window included."""
+    from rocket_tpu.models.generate import generate
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=40,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+        attention_window=4,
+    )
+    prompt = jnp.asarray(
+        np.random.default_rng(5).integers(0, 64, size=(2, 6)), jnp.int32
+    )
+    model = TransformerLM(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+    new = 20  # far past the window of 4
+
+    toks = prompt
+    for _ in range(new):  # ground truth: full windowed forward each step
+        out = model.apply({"params": params}, {"tokens": toks}, train=False)
+        nxt = jnp.argmax(out["logits"][:, -1], axis=-1).astype(jnp.int32)
+        toks = jnp.concatenate([toks, nxt[:, None]], axis=1)
+
+    got = generate(model, params, prompt, new, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(toks))
+
+    # mistral preset carries the window
+    assert TransformerConfig.mistral_7b().attention_window == 4096

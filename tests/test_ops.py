@@ -359,3 +359,57 @@ def test_auto_blocks_shape_aware_defaults():
     assert auto_blocks(128) == (128, 128)
     assert auto_blocks(197) == (197, 197)   # ViT: one S-sized block
     assert auto_blocks(768) == (256, 256)
+
+
+def test_sliding_window_attention_matches_reference_mask(devices):
+    """window=W (Mistral-style) must equal a hand-masked softmax in both
+    the dot path and the flash kernel (fwd AND grads), and window >= S
+    must reduce to full causal."""
+    from rocket_tpu.ops.attention import dot_attention
+    from rocket_tpu.ops.flash import flash_attention
+
+    B, S, H, D, W = 2, 256, 2, 16, 96
+    key = jax.random.PRNGKey(0)
+    q, k, v = (jax.random.normal(jax.random.fold_in(key, i), (B, S, H, D))
+               for i in range(3))
+
+    def reference(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (D ** -0.5)
+        pos = jnp.arange(S)
+        mask = (pos[:, None] >= pos[None, :]) & (
+            pos[:, None] - pos[None, :] < W)
+        logits = jnp.where(mask[None, None], logits, -1e30)
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", jax.nn.softmax(logits, axis=-1), v)
+
+    want = reference(q, k, v)
+    got_dot = dot_attention(q, k, v, causal=True, window=W)
+    np.testing.assert_allclose(np.asarray(got_dot), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+    got_flash = flash_attention(q, k, v, causal=True, window=W,
+                                block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(got_flash), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
+
+    # grads through the custom_vjp kernels
+    def loss(fn):
+        return lambda q, k, v: jnp.sum(fn(q, k, v) ** 2)
+
+    g_ref = jax.grad(loss(reference), argnums=(0, 1, 2))(q, k, v)
+    g_flash = jax.grad(
+        loss(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=W, block_q=128, block_k=128)),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    for a, b in zip(g_flash, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-2, atol=2e-2)
+
+    # window >= S degenerates to plain causal
+    full = dot_attention(q, k, v, causal=True)
+    wide = dot_attention(q, k, v, causal=True, window=S + 7)
+    np.testing.assert_allclose(np.asarray(wide), np.asarray(full),
+                               rtol=1e-6, atol=1e-6)
+
+    with pytest.raises(ValueError, match="window"):
+        flash_attention(q, k, v, causal=False, window=W)
