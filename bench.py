@@ -252,8 +252,15 @@ def gpt2_step_flops(cfg: TransformerConfig, batch: int, seq: int) -> float:
     )
     tokens = batch * seq
     dense = 6.0 * n_params * tokens
-    # attention scores+context: fwd 2*2*B*H*S^2*D, bwd ~2x
+    # attention scores+context: fwd 2*2*B*H*S^2*D, bwd ~2x.  The full-
+    # causal convention (the committed r2-r4 numbers) stays untouched; a
+    # sliding window attends W*S - W(W-1)/2 pairs instead of the causal
+    # S(S+1)/2, so the term scales by that ratio — crediting the full
+    # square would inflate windowed-point MFU by phantom FLOPs.
     attn = 3.0 * 2.0 * 2.0 * batch * cfg.n_heads * seq * seq * cfg.head_dim
+    W = min(cfg.attention_window or seq, seq)
+    if W < seq:
+        attn *= (W * seq - W * (W - 1) / 2.0) / (seq * (seq + 1) / 2.0)
     return dense + attn
 
 
@@ -419,6 +426,10 @@ GPT2_TUNE = dict(batch=16, seq=1024, block_q=None, block_k=None,
                  vocab=50304, scan_layers=False, remat=False,
                  fused_qkv=False, fused_ce=False, ce_chunk=1024,
                  remat_policy="nothing", attention="auto",
+                 # sliding-window attention (None = full causal); the
+                 # long-seq ablation point measures the flash kernel's
+                 # out-of-window block skipping on chip
+                 window=None,
                  # first-moment dtype ("bf16" -> optax.adamw(mu_dtype=...)).
                  # NOTE: optax casts only mu — nu has no dtype knob and
                  # bf16 squared-grad accumulators would be lossy anyway —
@@ -534,6 +545,7 @@ def _gpt2_cfg_kwargs(t: dict) -> dict:
         attention=t.get("attention", "auto"),
         attention_block_q=t["block_q"],
         attention_block_k=t["block_k"],
+        attention_window=t.get("window"),
     )
 
 
