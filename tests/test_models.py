@@ -1643,3 +1643,37 @@ def test_sliding_window_decode_matches_full_forward(devices):
 
     # mistral preset carries the window
     assert TransformerConfig.mistral_7b().attention_window == 4096
+
+
+def test_speculative_batched_with_sliding_window(devices):
+    """The batched decoder's per-row cache masking must compose with
+    attention_window: windowed batched speculative decode stays
+    bit-exact vs windowed plain greedy, past the window length."""
+    from rocket_tpu.models.generate import (
+        generate, speculative_generate_batched)
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    kw = dict(norm="layernorm", mlp="gelu", positions="learned",
+              tie_embeddings=True, use_bias=True, attention="dot",
+              attention_window=4)
+    cfg = TransformerConfig(vocab_size=64, hidden=32, n_layers=2,
+                            n_heads=4, max_seq=48, **kw)
+    dcfg = TransformerConfig(vocab_size=64, hidden=16, n_layers=1,
+                             n_heads=2, max_seq=48, **kw)
+    prompt = jnp.asarray(
+        np.random.default_rng(7).integers(0, 64, size=(4, 6)), jnp.int32
+    )
+    model, draft = TransformerLM(cfg), TransformerLM(dcfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+    draft_params = nn.meta.unbox(
+        draft.init(jax.random.PRNGKey(2), {"tokens": prompt})["params"]
+    )
+    want = np.asarray(
+        generate(model, params, prompt, 20, temperature=0.0)
+    )
+    got = speculative_generate_batched(
+        model, params, draft, draft_params, prompt, 20, n_draft=3,
+    )
+    np.testing.assert_array_equal(np.asarray(got), want)

@@ -413,3 +413,24 @@ def test_sliding_window_attention_matches_reference_mask(devices):
 
     with pytest.raises(ValueError, match="window"):
         flash_attention(q, k, v, causal=False, window=W)
+
+
+def test_sliding_window_with_segments_and_gqa(devices):
+    """window composes with packed segment_ids and GQA-grouped K/V: the
+    flash kernel must match the dot path with both masks active."""
+    from rocket_tpu.ops.attention import dot_attention
+    from rocket_tpu.ops.flash import flash_attention
+
+    B, S, H, KV, D, W = 2, 256, 4, 2, 16, 64
+    key = jax.random.PRNGKey(3)
+    q = jax.random.normal(key, (B, S, H, D))
+    k = jax.random.normal(jax.random.fold_in(key, 1), (B, S, KV, D))
+    v = jax.random.normal(jax.random.fold_in(key, 2), (B, S, KV, D))
+    seg = jnp.asarray(
+        np.repeat(np.arange(4), S // 4)[None].repeat(B, 0), jnp.int32
+    )
+    want = dot_attention(q, k, v, causal=True, segment_ids=seg, window=W)
+    got = flash_attention(q, k, v, causal=True, segment_ids=seg, window=W,
+                          block_q=128, block_k=128)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-3, atol=2e-3)
