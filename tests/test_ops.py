@@ -332,15 +332,24 @@ def test_long_context_16k_ring_training_step(devices):
     Runs in a FRESH subprocess (tests/long_context_worker.py): inside a
     long pytest session the accumulated XLA:CPU state makes this
     largest-in-the-suite program abort (SIGABRT at result fetch) even
-    with >100GB free — in a clean interpreter it passes in seconds."""
+    with >100GB free — in a clean interpreter it passes in seconds.
+    A SIGABRT gets ONE retry after a pause: the same abort also fires
+    under transient host memory/thread pressure (e.g. a concurrent
+    pytest process), and a retried clean pass distinguishes that from
+    a real regression."""
     import subprocess
     import sys
+    import time
 
     worker = os.path.join(os.path.dirname(__file__), "long_context_worker.py")
-    proc = subprocess.run(
-        [sys.executable, worker], timeout=600.0,
-        capture_output=True, text=True,
-    )
+    for attempt in (0, 1):
+        proc = subprocess.run(
+            [sys.executable, worker], timeout=600.0,
+            capture_output=True, text=True,
+        )
+        if proc.returncode == 0 or proc.returncode != -6:
+            break
+        time.sleep(10.0)  # transient pressure: give the host a beat
     assert proc.returncode == 0, (proc.stdout or "") + (proc.stderr or "")
     assert "long-context-ok" in proc.stdout
 
