@@ -7,8 +7,9 @@ Beyond reference parity (the reference ships no model code at all, SURVEY
   buffers (``models.transformer.Attention._decode_attend``) — no dynamic
   shapes anywhere, so the whole generate loop compiles once;
 - prefill is ONE batched forward over the prompt (writes the cache at
-  position 0), then a ``lax.scan`` emits one token per step — the
-  standard compile-once decode loop;
+  position 0) — or slack-sized chunked forwards when the config uses
+  the rolling KV cache (``decode_rolling_cache``) — then a ``lax.scan``
+  emits one token per step, the standard compile-once decode loop;
 - sampling: greedy (``temperature=0``), temperature softmax, optional
   top-k truncation, all per-step under the scan.
 
@@ -88,6 +89,35 @@ def zero_cache(model: Any, params: Any, prompt: jax.Array) -> Any:
     )
 
 
+def _chunked_prefill(model, params, cache, prompt):
+    """Run the prompt through the decode path and return
+    ``(cache, last-position f32 logits)``.
+
+    One forward for a plain cache; slack-sized chunks for a rolling
+    cache (``decode_rolling_cache``) — a single chunk's writes must not
+    clobber keys still inside a live query's window, and only the final
+    chunk's last-position logits matter to any caller."""
+    B, P = prompt.shape
+    step_len = (
+        model.config.decode_rolling_slack
+        if getattr(model.config, "decode_rolling_cache", False) else P
+    )
+    out = None
+    for c0 in range(0, P, step_len):
+        piece = prompt[:, c0:c0 + step_len]
+        pos = jnp.broadcast_to(
+            jnp.arange(c0, c0 + piece.shape[1], dtype=jnp.int32),
+            (B, piece.shape[1]),
+        )
+        out, mutated = model.apply(
+            {"params": params, "cache": cache},
+            {"tokens": piece, "positions": pos},
+            decode=True, mutable=["cache"],
+        )
+        cache = mutated["cache"]
+    return cache, out["logits"][:, -1].astype(jnp.float32)
+
+
 def generate(
     model: Any,
     params: Any,
@@ -129,18 +159,11 @@ def generate(
     if rng is None:
         rng = jax.random.PRNGKey(0)
 
-    cache = zero_cache(model, params, prompt)
-
-    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
-    out, mutated = model.apply(
-        {"params": params, "cache": cache},
-        {"tokens": prompt, "positions": positions},
-        decode=True,
-        mutable=["cache"],
+    cache, last = _chunked_prefill(
+        model, params, zero_cache(model, params, prompt), prompt
     )
-    cache = mutated["cache"]
     rng, sub = jax.random.split(rng)
-    tok = _sample(out["logits"][:, -1], sub, temperature, top_k, top_p)
+    tok = _sample(last, sub, temperature, top_k, top_p)
     done = jnp.zeros((B,), bool) if eos_token is None else tok == eos_token
     if eos_token is not None:
         eos = jnp.asarray(eos_token, jnp.int32)
@@ -462,29 +485,21 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt,
     if key is None:
         key = jax.random.PRNGKey(0)
 
-    # prefill both models over the prompt (uniform frontiers: all rows 0)
-    cache_t = zero_cache(model, params, prompt)
-    cache_d = zero_cache(draft_model, draft_params, prompt)
-    positions = jnp.broadcast_to(jnp.arange(P, dtype=jnp.int32), (B, P))
-    out, mut = model.apply(
-        {"params": params, "cache": cache_t},
-        {"tokens": prompt, "positions": positions},
-        decode=True, mutable=["cache"],
+    # prefill both models over the prompt (uniform frontiers: all rows
+    # 0); a rolling-cache model prefills in slack-sized chunks
+    cache_t, last = _chunked_prefill(
+        model, params, zero_cache(model, params, prompt), prompt
     )
-    cache_t = mut["cache"]
-    last = out["logits"][:, -1].astype(jnp.float32)
+    cache_d, _ = _chunked_prefill(
+        draft_model, draft_params,
+        zero_cache(draft_model, draft_params, prompt), prompt
+    )
     if sampled:
         key, kg = jax.random.split(key)
         g = jax.random.categorical(
             kg, last / temperature, axis=-1).astype(jnp.int32)
     else:
         g = jnp.argmax(last, axis=-1).astype(jnp.int32)
-    _, mut = draft_model.apply(
-        {"params": draft_params, "cache": cache_d},
-        {"tokens": prompt, "positions": positions},
-        decode=True, mutable=["cache"],
-    )
-    cache_d = mut["cache"]
 
     buf = jnp.zeros((B, total), jnp.int32)
     buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
@@ -634,6 +649,14 @@ def _spec_batched_call(model, draft_model, params, draft_params, prompt,
                 f"max_seq ({m.config.max_seq}); the verify chunk can write "
                 f"up to n_draft slots past the final token — size max_seq "
                 f"with that slack"
+            )
+        if (getattr(m.config, "decode_rolling_cache", False)
+                and n_draft + 1 > m.config.decode_rolling_slack):
+            raise ValueError(
+                f"n_draft + 1 = {n_draft + 1} exceeds {label}'s "
+                f"decode_rolling_slack ({m.config.decode_rolling_slack}) "
+                f"— the verify chunk must fit the rolling cache's slack "
+                f"region"
             )
     per_row = lambda m: type(m)(  # noqa: E731
         dataclasses.replace(m.config, decode_per_row=True)

@@ -97,6 +97,17 @@ class TransformerConfig:
     fused_ce: bool = False
     # Tokens per fused-CE chunk; peak transient memory is chunk * vocab f32.
     fused_ce_chunk: int = 1024
+    # Rolling KV cache for windowed decode (opt-in): the decode cache
+    # holds attention_window + decode_rolling_slack slots instead of
+    # max_seq — O(window) serving memory however long the generation.
+    # Slots are addressed position-mod-slots; the slack region
+    # guarantees a chunk's writes never clobber a key still inside any
+    # live query's window, so every decode chunk (a prefill piece, a
+    # speculative verify chunk) must be <= decode_rolling_slack tokens
+    # — generate()/the batched decoder chunk their prefill accordingly.
+    # Requires attention_window; positions (RoPE/learned) stay absolute.
+    decode_rolling_cache: bool = False
+    decode_rolling_slack: int = 128
     # Per-row KV-cache frontiers for decode: cache writes and the causal
     # mask derive from the caller's ``positions`` (first column = each
     # row's write offset) instead of the shared scalar ``cache_index``.
@@ -158,6 +169,17 @@ class TransformerConfig:
                 f"attention_window={self.attention_window} requires "
                 f"causal=True and a window >= 1"
             )
+        if self.decode_rolling_cache:
+            if self.attention_window is None:
+                raise ValueError(
+                    "decode_rolling_cache requires attention_window (an "
+                    "unbounded-context cache cannot roll)"
+                )
+            if self.decode_rolling_slack < 1:
+                raise ValueError(
+                    f"decode_rolling_slack must be >= 1, got "
+                    f"{self.decode_rolling_slack}"
+                )
         if self.weights_int8 and self.scan_layers:
             raise ValueError(
                 "weights_int8 requires the unrolled layer layout "
@@ -369,11 +391,15 @@ class Attention(nn.Module):
         cfg = self.config
         B, S, KV, D = k.shape
         is_filled = self.has_variable("cache", "cached_k")
+        n_slots = (
+            cfg.attention_window + cfg.decode_rolling_slack
+            if cfg.decode_rolling_cache else cfg.max_seq
+        )
         cached_k = self.variable(
-            "cache", "cached_k", jnp.zeros, (B, cfg.max_seq, KV, D), k.dtype
+            "cache", "cached_k", jnp.zeros, (B, n_slots, KV, D), k.dtype
         )
         cached_v = self.variable(
-            "cache", "cached_v", jnp.zeros, (B, cfg.max_seq, KV, D), v.dtype
+            "cache", "cached_v", jnp.zeros, (B, n_slots, KV, D), v.dtype
         )
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
@@ -385,6 +411,38 @@ class Attention(nn.Module):
             return attend(q, k, v, impl="dot", causal=cfg.causal,
                           window=cfg.attention_window)
         idx = cache_index.value
+        if cfg.decode_rolling_cache:
+            if S > cfg.decode_rolling_slack:
+                raise ValueError(
+                    f"decode chunk of {S} tokens exceeds "
+                    f"decode_rolling_slack ({cfg.decode_rolling_slack}) — "
+                    f"chunk the prefill (generate() does this when the "
+                    f"config rolls)"
+                )
+            starts = positions[:, 0].astype(jnp.int32)     # [B]
+            slots = (
+                starts[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+            ) % n_slots                                    # [B, S], unique
+            row_scatter = jax.vmap(lambda c, u, sl: c.at[sl].set(u))
+            k_all = row_scatter(cached_k.value, k, slots)
+            v_all = row_scatter(cached_v.value, v, slots)
+            cached_k.value = k_all
+            cached_v.value = v_all
+            cache_index.value = jnp.max(starts) + S
+            # Implied position per slot: the largest position <= this
+            # chunk's end congruent to the slot index.  A slot whose
+            # STORED position is newer (stale speculative writes) maps
+            # at least n_slots lower — below every live window — so the
+            # mask hides it; negatives mean never-written slots.
+            chunk_end = starts + S - 1                     # [B]
+            s_idx = jnp.arange(n_slots, dtype=jnp.int32)[None, :]
+            k_pos = chunk_end[:, None] - (
+                (chunk_end[:, None] - s_idx) % n_slots
+            )
+            return dot_attention(
+                q, k_all, v_all, causal=True, q_offset=starts,
+                window=cfg.attention_window, k_positions=k_pos,
+            )
         if cfg.decode_per_row:
             starts = positions[:, 0].astype(jnp.int32)
             row_write = jax.vmap(

@@ -51,6 +51,7 @@ def dot_attention(
     q_offset: Optional[Array] = None,
     kv_mask: Optional[Array] = None,
     window: Optional[int] = None,
+    k_positions: Optional[Array] = None,
 ) -> Array:
     """Reference einsum attention. Computes logits in f32 for stability
     regardless of the compute dtype (bf16 inputs stay bf16 on the matmuls —
@@ -70,6 +71,12 @@ def dot_attention(
     negative, not ``-inf``: a fully-masked row (an all-padding dummy
     input in a wrap-around batch) then degrades to uniform weights
     instead of a batch-poisoning softmax NaN.
+
+    ``k_positions`` (``[B, S_k]`` int) gives each key slot an EXPLICIT
+    sequence position instead of its array index — the rolling-KV-cache
+    case, where slot ``s`` holds whatever position last wrote it (and
+    ``-1``-ish negatives mean never written).  Causal/window masking
+    then compares ``q_pos`` against these values; requires ``causal``.
     """
     B, S, H, D = q.shape
     if window is not None and (not causal or window < 1):
@@ -84,7 +91,21 @@ def dot_attention(
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
     logits = logits * scale
     neg = jnp.asarray(-0.7 * jnp.finfo(jnp.float32).max, logits.dtype)
-    if causal:
+    if k_positions is not None:
+        if not causal:
+            raise ValueError("k_positions requires causal=True")
+        # q positions: arange(S) offset per row (or shared scalar)
+        q_pos = jnp.arange(S)[None, :]
+        if q_offset is not None:
+            off = jnp.asarray(q_offset)
+            q_pos = q_pos + (off[:, None] if off.ndim == 1 else off)
+        kp = k_positions[:, None, :]          # [B, 1, K]
+        qp = q_pos[:, :, None]                # [B, S, 1]
+        mask = (kp >= 0) & (kp <= qp)
+        if window is not None:
+            mask &= (qp - kp) < window
+        logits = jnp.where(mask[:, None], logits, neg)
+    elif causal:
         k_pos = jnp.arange(k.shape[1])
         if q_offset is not None and jnp.ndim(q_offset) == 1:
             # per-row offsets: mask is [B, S, K], broadcast over heads

@@ -1677,3 +1677,85 @@ def test_speculative_batched_with_sliding_window(devices):
         model, params, draft, draft_params, prompt, 20, n_draft=3,
     )
     np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_rolling_kv_cache_matches_full_cache(devices):
+    """decode_rolling_cache: O(window) serving memory with bit-exact
+    outputs.  Multi-layer, prompt and generation both far past the
+    window — the chunked prefill plus slot-position masking must
+    reproduce the full-cache windowed decode exactly, greedy and
+    sampled, plain and through the batched speculative decoder."""
+    import dataclasses
+
+    from rocket_tpu.models.generate import (
+        decode_cache_shapes, generate, speculative_generate_batched)
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    kw = dict(norm="layernorm", mlp="gelu", positions="learned",
+              tie_embeddings=True, use_bias=True, attention="dot",
+              attention_window=8)
+    cfg = TransformerConfig(vocab_size=64, hidden=32, n_layers=2,
+                            n_heads=4, max_seq=96, **kw)
+    roll = dataclasses.replace(
+        cfg, decode_rolling_cache=True, decode_rolling_slack=8)
+    prompt = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(3, 20)), jnp.int32
+    )
+    model, rmodel = TransformerLM(cfg), TransformerLM(roll)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+
+    want = np.asarray(generate(model, params, prompt, 40, temperature=0.0))
+    got = np.asarray(generate(rmodel, params, prompt, 40, temperature=0.0))
+    np.testing.assert_array_equal(got, want)
+
+    # sampled path: chunked prefill must not perturb the rng stream
+    key = jax.random.PRNGKey(9)
+    w_s = np.asarray(generate(model, params, prompt, 24, rng=key,
+                              temperature=0.9, top_k=20))
+    g_s = np.asarray(generate(rmodel, params, prompt, 24, rng=key,
+                              temperature=0.9, top_k=20))
+    np.testing.assert_array_equal(g_s, w_s)
+
+    # the whole point: window+slack slots, not max_seq
+    shapes = decode_cache_shapes(rmodel, params, prompt)
+    slots = {a.shape[1] for a in jax.tree_util.tree_leaves(shapes)
+             if a.ndim == 4}
+    assert slots == {16}, slots
+
+    # batched speculative decode over rolling caches stays bit-exact
+    droll = dataclasses.replace(roll, hidden=16, n_heads=2, n_layers=1)
+    draft = TransformerLM(droll)
+    dparams = nn.meta.unbox(
+        draft.init(jax.random.PRNGKey(2), {"tokens": prompt})["params"]
+    )
+    spec = np.asarray(speculative_generate_batched(
+        rmodel, params, draft, dparams, prompt, 40, n_draft=3))
+    np.testing.assert_array_equal(spec, want)
+
+
+def test_rolling_kv_cache_validation(devices):
+    import dataclasses
+
+    from rocket_tpu.models.generate import speculative_generate_batched
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    with pytest.raises(ValueError, match="decode_rolling_cache"):
+        TransformerConfig(decode_rolling_cache=True)  # no window
+
+    kw = dict(norm="layernorm", mlp="gelu", positions="learned",
+              tie_embeddings=True, use_bias=True, attention="dot",
+              attention_window=8, decode_rolling_cache=True,
+              decode_rolling_slack=2)
+    cfg = TransformerConfig(vocab_size=64, hidden=16, n_layers=1,
+                            n_heads=2, max_seq=64, **kw)
+    model = TransformerLM(cfg)
+    prompt = jnp.zeros((1, 4), jnp.int32)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(0), {"tokens": prompt})["params"]
+    )
+    # a verify chunk of n_draft+1=4 > slack 2 must be rejected up front
+    with pytest.raises(ValueError, match="decode_rolling_slack"):
+        speculative_generate_batched(
+            model, params, model, params, prompt, 8, n_draft=3)
