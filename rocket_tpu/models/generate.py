@@ -30,27 +30,20 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
-            top_k: Optional[int], top_p: Optional[float] = None) -> jax.Array:
-    """One sampling step on ``[B, V]`` logits (greedy / temperature /
-    top-k / top-p nucleus, composable: top-k truncates first, then the
-    nucleus is taken within what survives)."""
-    if top_p is not None and not 0.0 < top_p <= 1.0:
-        # Validate even on the greedy path: a bad top_p must not hide
-        # behind the temperature<=0 early return.
-        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
-    if temperature <= 0.0:
-        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    logits = logits.astype(jnp.float32) / temperature
+def _truncate_logits(logits: jax.Array, top_k: Optional[int],
+                     top_p: Optional[float]) -> jax.Array:
+    """Apply top-k / top-p truncation to temperature-scaled ``[..., V]``
+    logits (masked entries -> -inf; composable — top-k truncates first,
+    the nucleus is taken within what survives)."""
     if top_k is not None:
-        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     if top_p is not None:
         # Nucleus: smallest prefix of the sorted distribution with
         # cumulative mass >= top_p.  Sorted-space mask scattered back via
         # argsort-of-argsort (static shapes, no dynamic slicing); one
         # argsort + one gather, not a second value sort.
-        order = jnp.argsort(logits, axis=-1)[:, ::-1]
+        order = jnp.flip(jnp.argsort(logits, axis=-1), axis=-1)
         sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
         probs = jax.nn.softmax(sorted_logits, axis=-1)
         cum = jnp.cumsum(probs, axis=-1)
@@ -60,6 +53,21 @@ def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
         ranks = jnp.argsort(order, axis=-1)
         keep = jnp.take_along_axis(keep_sorted, ranks, axis=-1)
         logits = jnp.where(keep, logits, -jnp.inf)
+    return logits
+
+
+def _sample(logits: jax.Array, rng: jax.Array, temperature: float,
+            top_k: Optional[int], top_p: Optional[float] = None) -> jax.Array:
+    """One sampling step on ``[B, V]`` logits (greedy / temperature /
+    top-k / top-p nucleus)."""
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        # Validate even on the greedy path: a bad top_p must not hide
+        # behind the temperature<=0 early return.
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = _truncate_logits(logits.astype(jnp.float32) / temperature,
+                              top_k, top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -457,11 +465,13 @@ def _accept_resample_rows(p_rows: jax.Array, q_rows: jax.Array,
 
 @functools.partial(
     jax.jit, static_argnums=(0, 1),
-    static_argnames=("max_new_tokens", "n_draft", "eos_token", "sampled"),
+    static_argnames=("max_new_tokens", "n_draft", "eos_token", "sampled",
+                     "top_k"),
 )
 def _spec_batched_run(model, draft_model, params, draft_params, prompt,
                       key=None, temperature=0.0, *, max_new_tokens,
-                      n_draft, eos_token, sampled=False):
+                      n_draft, eos_token, sampled=False, top_k=None,
+                      top_p=None):
     """The device-resident round loop behind
     :func:`speculative_generate_batched` (``sampled=False``: greedy,
     draft-agreement acceptance) and :func:`speculative_sample_batched`
@@ -469,9 +479,10 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt,
     :func:`_accept_resample_rows`) — one ``lax.while_loop``, zero host
     syncs until the final result.  ``model``/``draft_model`` must be
     ``decode_per_row`` variants (rows keep independent frontiers).
-    Only the boolean mode is a static (recompiling) argument;
-    ``temperature`` is a traced operand so per-request temperatures
-    reuse one compiled executable.
+    Static (recompiling) arguments: the boolean mode and ``top_k``
+    (a lax.top_k shape).  ``temperature`` and ``top_p`` are traced
+    operands, so per-request values reuse one compiled executable
+    (top_p's None-ness still splits the cache once).
 
     Why no cache rewinds: with per-row positions, a stale K/V slot past
     a row's frontier has a key position larger than every live query
@@ -497,7 +508,9 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt,
     if sampled:
         key, kg = jax.random.split(key)
         g = jax.random.categorical(
-            kg, last / temperature, axis=-1).astype(jnp.int32)
+            kg, _truncate_logits(last / temperature, top_k, top_p),
+            axis=-1,
+        ).astype(jnp.int32)
     else:
         g = jnp.argmax(last, axis=-1).astype(jnp.int32)
 
@@ -536,9 +549,14 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt,
             )
             logits = out["logits"][:, 0].astype(jnp.float32)
             if sampled:
+                # truncated-renormalized q: the accept/resample theorem
+                # holds for ANY q as long as p and q are the actual
+                # proposal/verify distributions — truncating both makes
+                # the emitted tokens exactly truncated-target-distributed
+                logits = _truncate_logits(logits / temperature, top_k, top_p)
                 nxt = jax.random.categorical(
-                    ki, logits / temperature, axis=-1).astype(jnp.int32)
-                q_row = jax.nn.softmax(logits / temperature, axis=-1)
+                    ki, logits, axis=-1).astype(jnp.int32)
+                q_row = jax.nn.softmax(logits, axis=-1)
             else:
                 nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 q_row = jnp.zeros((B, 0), jnp.float32)  # unused
@@ -565,7 +583,10 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt,
             # rejection sampling: accept d_i with prob min(1, p/q); the
             # emitted tokens are the accepted DRAFTS plus the round's
             # resample/bonus draw
-            p_rows = jax.nn.softmax(t_logits / temperature, axis=-1)
+            p_rows = jax.nn.softmax(
+                _truncate_logits(t_logits / temperature, top_k, top_p),
+                axis=-1,
+            )
             q_rows = q_t[:k].swapaxes(0, 1)                 # [B, k, V]
             j, tok = _accept_resample_rows(
                 p_rows, q_rows, drafts, key_accept)
@@ -628,7 +649,8 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt,
 
 def _spec_batched_call(model, draft_model, params, draft_params, prompt,
                        max_new_tokens, n_draft, eos_token, return_stats,
-                       key=None, temperature=0.0, sampled=False):
+                       key=None, temperature=0.0, sampled=False,
+                       top_k=None, top_p=None):
     """Shared front door for both batched speculative wrappers:
     validation (including the max_seq + n_draft slack rule), the
     ``decode_per_row`` model variants, the run, and stats packaging —
@@ -664,7 +686,7 @@ def _spec_batched_call(model, draft_model, params, draft_params, prompt,
     buf, (rounds, drafted, accepted) = _spec_batched_run(
         per_row(model), per_row(draft_model), params, draft_params, prompt,
         key, temperature, max_new_tokens=max_new_tokens, n_draft=n_draft,
-        eos_token=eos_token, sampled=sampled,
+        eos_token=eos_token, sampled=sampled, top_k=top_k, top_p=top_p,
     )
     if return_stats:
         return buf, {"rounds": int(rounds),
@@ -726,6 +748,8 @@ def speculative_sample_batched(
     max_new_tokens: int,
     n_draft: int = 4,
     temperature: float = 1.0,
+    top_k: Optional[int] = None,
+    top_p: Optional[float] = None,
     rng: Optional[jax.Array] = None,
     return_stats: bool = False,
     eos_token: Optional[int] = None,
@@ -753,11 +777,19 @@ def speculative_sample_batched(
             "speculative_sample_batched needs temperature > 0; use "
             "speculative_generate_batched for greedy decoding"
         )
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if top_k is not None and top_k < 1:
+        # validate here: an invalid k otherwise dies deep inside the
+        # jitted trace with an opaque lax.top_k error
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
     key = rng if rng is not None else jax.random.PRNGKey(0)
     return _spec_batched_call(
         model, draft_model, params, draft_params, prompt,
         max_new_tokens, n_draft, eos_token, return_stats,
         key=key, temperature=jnp.float32(temperature), sampled=True,
+        top_k=top_k,
+        top_p=None if top_p is None else jnp.float32(top_p),
     )
 
 

@@ -1759,3 +1759,45 @@ def test_rolling_kv_cache_validation(devices):
     with pytest.raises(ValueError, match="decode_rolling_slack"):
         speculative_generate_batched(
             model, params, model, params, prompt, 8, n_draft=3)
+
+
+def test_speculative_sample_batched_topk_and_nucleus(devices):
+    """Truncated-distribution speculative sampling: top_k=1 collapses
+    to greedy (must equal generate temperature=0 exactly); top_k/top_p
+    runs stay reproducible and in-vocab; identical draft still accepts
+    everything under the same truncation."""
+    from rocket_tpu.models.generate import (
+        generate, speculative_sample_batched)
+
+    model, params, draft, draft_params, prompt = _spec_batched_setup(B=4)
+    want = np.asarray(generate(model, params, prompt, 12, temperature=0.0))
+    got = np.asarray(speculative_sample_batched(
+        model, params, draft, draft_params, prompt, 12, n_draft=3,
+        temperature=0.7, top_k=1, rng=jax.random.PRNGKey(5),
+    ))
+    np.testing.assert_array_equal(got, want)
+
+    out, stats = speculative_sample_batched(
+        model, params, draft, draft_params, prompt, 12, n_draft=3,
+        temperature=0.8, top_k=8, top_p=0.9, rng=jax.random.PRNGKey(6),
+        return_stats=True,
+    )
+    o = np.asarray(out)
+    assert (o >= 0).all() and (o < 64).all()
+    again = np.asarray(speculative_sample_batched(
+        model, params, draft, draft_params, prompt, 12, n_draft=3,
+        temperature=0.8, top_k=8, top_p=0.9, rng=jax.random.PRNGKey(6),
+    ))
+    np.testing.assert_array_equal(again, o)
+
+    _, s2 = speculative_sample_batched(
+        model, params, model, params, prompt, 12, n_draft=4,
+        temperature=1.0, top_k=8, rng=jax.random.PRNGKey(3),
+        return_stats=True,
+    )
+    assert np.array_equal(s2["accepted"], s2["drafted"]), s2
+
+    with pytest.raises(ValueError, match="top_p"):
+        speculative_sample_batched(
+            model, params, draft, draft_params, prompt, 4,
+            temperature=0.8, top_p=1.5)
