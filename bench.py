@@ -65,13 +65,38 @@ def _probe_backend(timeout_s: float) -> str:
     return tail[-1] if tail else f"exit {proc.returncode}"
 
 
+def _tune_matches_headline(tune) -> bool:
+    """Does a record's gpt2 tune dict describe the CURRENT headline
+    ``GPT2_TUNE`` config?  Records predate later-added knobs, so missing
+    keys take today's defaults; ``block_q``/``block_k`` ``None`` resolve
+    through the shape-aware ``ops.flash.auto_blocks`` the model actually
+    runs, so an explicitly-measured 512/1024 at seq 1024 equals today's
+    ``None``/``None`` library default."""
+    if not isinstance(tune, dict) or set(tune) - set(GPT2_TUNE):
+        return False
+    from rocket_tpu.ops.flash import auto_blocks
+
+    def canon(t):
+        eff = dict(GPT2_TUNE, **t)
+        bq, bk = auto_blocks(int(eff["seq"]))
+        eff["block_q"] = bq if eff["block_q"] is None else eff["block_q"]
+        eff["block_k"] = bk if eff["block_k"] is None else eff["block_k"]
+        return eff
+
+    return canon(tune) == canon(GPT2_TUNE)
+
+
 def _last_good_ladder() -> dict:
     """Last-good measured record per ladder config from the committed
     ``experiments/bench_runs.jsonl`` artifact.
 
-    Sweep points are excluded (they measure deliberately-bad ablations);
-    so are suspect records and errored runs.  Later lines win: the result
-    is the most recent trustworthy measurement of each ladder entry."""
+    Sweep points are excluded (they measure deliberately-bad ablations)
+    — EXCEPT a gpt2 point whose effective tune IS the current
+    ``GPT2_TUNE``: that point measured the headline config itself (the
+    round-4 sweep's bs16 winner became the default), so it outranks any
+    older plain record of a superseded tune (VERDICT r5 #5).  Suspect
+    records and errored runs are excluded too.  Later lines win: the
+    result is the most recent trustworthy measurement of each entry."""
     path = os.path.join(
         os.path.dirname(os.path.abspath(__file__)),
         "experiments", "bench_runs.jsonl",
@@ -84,8 +109,7 @@ def _last_good_ladder() -> dict:
                     rec = json.loads(line)
                 except ValueError:
                     continue
-                if ("sweep_point" in rec or "sweep_best" in rec
-                        or rec.get("kind") == "attribution"
+                if (rec.get("kind") == "attribution"
                         or rec.get("profiled")  # trace-overhead-skewed
                         # CPU smoke runs persist too; never replay one
                         # as a "last-good ON-CHIP measurement"
@@ -94,6 +118,20 @@ def _last_good_ladder() -> dict:
                     continue
                 cfg = rec.get("config")
                 if not cfg or rec.get("value") is None:
+                    continue
+                if "sweep_point" in rec or "sweep_best" in rec:
+                    if cfg == "gpt2" and _tune_matches_headline(
+                            rec.get("tune")):
+                        out = dict(rec)
+                        out.pop("sweep_point", None)
+                        out["promoted_from_sweep"] = True
+                        best[cfg] = out
+                    continue
+                # a plain record of a superseded tune must not clobber a
+                # promoted headline-tune measurement
+                if (cfg == "gpt2" and cfg in best
+                        and _tune_matches_headline(best[cfg].get("tune"))
+                        and not _tune_matches_headline(rec.get("tune"))):
                     continue
                 best[cfg] = rec
     except OSError:
@@ -707,8 +745,18 @@ def bench_gpt2_decode(n_steps, warmup):
 
     B = int(os.environ.get("BENCH_DECODE_BATCH", 8))
     int8 = bool(int(os.environ.get("BENCH_DECODE_INT8", "0")))
+    mode = os.environ.get("BENCH_DECODE_MODE", "generate")
+    if mode not in ("generate", "beam", "rounds"):
+        raise ValueError(
+            f"BENCH_DECODE_MODE must be generate|beam|rounds, got {mode!r}"
+        )
+    beam_k = int(os.environ.get("BENCH_DECODE_BEAM", 4))
+    n_draft = int(os.environ.get("BENCH_DECODE_NDRAFT", 4))
     PROMPT, NEW = 128, 128
-    cfg = TransformerConfig.gpt2_124m(vocab_size=50304, max_seq=PROMPT + NEW,
+    # rounds mode: the speculative verify chunk may write up to n_draft
+    # slots past the final token, so the static cache carries that slack
+    max_seq = PROMPT + NEW + (n_draft if mode == "rounds" else 0)
+    cfg = TransformerConfig.gpt2_124m(vocab_size=50304, max_seq=max_seq,
                                       weights_int8=int8)
     model = TransformerLM(cfg)
     rng = np.random.default_rng(0)
@@ -718,9 +766,7 @@ def bench_gpt2_decode(n_steps, warmup):
         # init trained-shaped f32 weights, then rewrite into the int8
         # layout — the same flow a user quantizing a checkpoint follows
         init_model = TransformerLM(
-            TransformerConfig.gpt2_124m(
-                vocab_size=50304, max_seq=PROMPT + NEW
-            )
+            TransformerConfig.gpt2_124m(vocab_size=50304, max_seq=max_seq)
         )
     variables = jax.jit(init_model.init)(
         jax.random.PRNGKey(0), {"tokens": prompt}
@@ -740,20 +786,55 @@ def bench_gpt2_decode(n_steps, warmup):
     # f32 + bf16/int8 copies resident through the measured decode loop
     del variables
 
-    def run(params, prompt, key):
-        return generate(model, params, prompt, NEW, rng=key, temperature=1.0)
+    extra = {}
+    if mode == "beam":
+        from rocket_tpu.models.generate import beam_search_cached
 
-    run = jax.jit(run)
-    key = jax.random.PRNGKey(1)
+        # eos_id -1 never matches a vocab token, so every call decodes
+        # the full NEW tokens and calls stay work-identical
+        bs_run = jax.jit(lambda p, tok: beam_search_cached(
+            model, p, tok, NEW, eos_id=-1, beam_size=beam_k)[0])
+
+        def run_call(i):
+            return bs_run(params, prompt)
+
+        extra = {"beam_size": beam_k}
+    elif mode == "rounds":
+        from rocket_tpu.models.generate import ContinuousBatcher
+
+        bat = ContinuousBatcher(model, model, params, params,
+                                total_len=PROMPT + NEW, n_draft=n_draft)
+
+        def run_call(i):
+            # round-at-a-time host loop — same math as the one-dispatch
+            # speculative path, but each round is its own dispatch; the
+            # delta vs plain decode prices the serving loop's ability to
+            # admit requests between rounds
+            bat.start(prompt)
+            while not bat.all_done:
+                bat.step()
+            return bat.state[0]
+
+        extra = {"n_draft": n_draft}
+    else:
+        run = jax.jit(lambda p, tok, key: generate(
+            model, p, tok, NEW, rng=key, temperature=1.0))
+        key = jax.random.PRNGKey(1)
+
+        def run_call(i):
+            return run(params, prompt, jax.random.fold_in(key, i))
+
     out = None
     for _ in range(max(1, warmup)):
-        out = run(params, prompt, key)
+        out = run_call(0)
     jax.block_until_ready(out)
     t0 = time.perf_counter()
     for i in range(n_steps):
-        out = run(params, prompt, jax.random.fold_in(key, i))
+        out = run_call(i)
         jax.block_until_ready(out)  # each call is an independent request
     elapsed = time.perf_counter() - t0
+    if mode == "rounds":
+        extra["rounds_per_call"] = int(bat.stats()["rounds"])
 
     per_call = elapsed / n_steps
     tok_per_s = B * NEW / per_call
@@ -779,17 +860,27 @@ def bench_gpt2_decode(n_steps, warmup):
     frontier = (PROMPT + NEW / 2) / (PROMPT + NEW)
     prefill_bytes = param_bytes + kv_bytes * PROMPT / (PROMPT + NEW)
     bytes_per_call = NEW * (param_bytes + kv_bytes * frontier) + prefill_bytes
-    mbu = bytes_per_call / per_call / peak_hbm_bytes_per_chip()
+    # the traffic model above assumes one decode row per request and one
+    # forward per token — beam tiles the cache K-wide and speculative
+    # rounds batch draft+verify, so MBU is only honest for plain decode
+    mbu = (bytes_per_call / per_call / peak_hbm_bytes_per_chip()
+           if mode == "generate" else None)
     wdt = "int8 weights" if int8 else "bf16"
+    cfg_name = "gpt2-decode-int8" if int8 else "gpt2-decode"
+    if mode != "generate":
+        cfg_name += f"-{mode}"
+    mode_note = {"beam": f", cached beam k={beam_k}",
+                 "rounds": f", round-granular spec n_draft={n_draft}"}
     return {
-        "config": "gpt2-decode-int8" if int8 else "gpt2-decode",
+        "config": cfg_name,
         "metric": f"gpt2-124m KV-cache decode (1 chip, {wdt}, bs{B}, "
-                  f"{PROMPT}+{NEW} tokens)",
+                  f"{PROMPT}+{NEW} tokens{mode_note.get(mode, '')})",
         "value": round(tok_per_s, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": None,
         "per_call_ms": round(per_call * 1e3, 2),
-        "mbu": round(mbu, 4),
+        "mbu": None if mbu is None else round(mbu, 4),
+        **extra,
         "device": jax.devices()[0].device_kind,
         "baseline_note": "reference has no generation path at all; MBU = "
                          "achieved HBM bytes/s over peak (decode is "
@@ -837,9 +928,11 @@ def main() -> None:
             "BENCH_GPT2_TUNE") and not os.environ.get("BENCH_NO_STALE"):
         stale_names = [args.only] if args.only else [
             "resnet50", "vit", "decode", "gpt2"]
-        if os.environ.get("BENCH_DECODE_INT8"):
-            # int8 decode records carry a different config key; re-emitting
-            # the bf16 record under an int8 run would mislabel it
+        if os.environ.get("BENCH_DECODE_INT8") or os.environ.get(
+                "BENCH_DECODE_MODE", "generate") != "generate":
+            # int8 / beam / rounds decode records carry a different
+            # config key; re-emitting the plain bf16 record under one of
+            # those runs would mislabel it
             stale_names = [n for n in stale_names if n != "decode"]
         if os.environ.get("BENCH_RESNET_IMAGE", "32") != "32":
             # same config-identity rule for the image-size knob: the

@@ -1,18 +1,28 @@
-"""Minimal continuous-batching serving loop over the batched decoder.
+"""Continuous-batching serving loop over the batched decoder — v2.
 
 The reference framework stops at training (SURVEY §2); this demo shows
-the serving pattern the TPU build supports end to end:
+the serving patterns the TPU build supports end to end, on ONE seeded
+request trace so the two disciplines are directly comparable:
 
-- requests arrive on a queue (simulated Poisson-ish arrivals);
-- a batcher groups up to ``--max-batch`` requests and PADS the batch to
-  a fixed width with dummy rows — static shapes mean the whole serving
-  process compiles exactly one executable, the TPU serving discipline
-  (a ragged batch would recompile per width);
-- each group decodes in ONE device dispatch via
-  ``speculative_generate_batched`` (int8 self-draft, per-row KV
-  frontiers, no per-token host sync);
-- per-request latency (arrival -> tokens) and aggregate throughput are
-  reported, plus the acceptance rate that drives the bandwidth win.
+- ``--mode group`` — the v1 discipline: a batcher groups up to
+  ``--max-batch`` requests, PADS the batch to a fixed width with dummy
+  rows (static shapes: the whole serving process compiles exactly one
+  executable), and each group decodes in ONE device dispatch via
+  ``speculative_generate_batched``.  A request that arrives while a
+  group is decoding waits for that group's SLOWEST row before its
+  prefill even starts.
+- ``--mode continuous`` — round-granular continuous batching via
+  :class:`rocket_tpu.models.generate.ContinuousBatcher`: the SAME round
+  body runs one speculative round per dispatch with the carry state
+  kept on device, so between rounds the loop admits a fresh request
+  into any finished row while the other rows keep decoding.  Nobody
+  waits for a group to drain; the demo logs each mid-batch join.
+- ``--mode both`` (default) runs both on the same trace and prints the
+  per-request p50 comparison.
+
+Both modes use the int8 self-draft speculative decoder (per-row KV
+frontiers, no per-token host sync) and report per-request latency
+(arrival -> tokens), aggregate throughput, and acceptance.
 
     python examples/serve_demo.py [--requests 24] [--max-batch 8]
 """
@@ -33,6 +43,7 @@ import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 from rocket_tpu.models.generate import (  # noqa: E402
+    ContinuousBatcher,
     speculative_generate_batched,
 )
 from rocket_tpu.models.transformer import (  # noqa: E402
@@ -55,20 +66,12 @@ def _cfg(**kw):
     )
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--requests", type=int, default=24)
-    parser.add_argument("--max-batch", type=int, default=8)
-    parser.add_argument("--arrival-ms", type=float, default=30.0,
-                        help="mean simulated inter-arrival gap")
-    args = parser.parse_args()
-
-    rng = np.random.default_rng(0)
-    model = TransformerLM(_cfg())
-    draft = TransformerLM(_cfg(weights_int8=True))
-    init_prompt = jnp.zeros((args.max_batch, PROMPT), jnp.int32)
+def _build():
     import flax.linen as nn
 
+    model = TransformerLM(_cfg())
+    draft = TransformerLM(_cfg(weights_int8=True))
+    init_prompt = jnp.zeros((1, PROMPT), jnp.int32)
     params = nn.meta.unbox(
         model.init(jax.random.PRNGKey(0), {"tokens": init_prompt})["params"]
     )
@@ -76,27 +79,24 @@ def main():
         lambda a: a.astype(jnp.bfloat16)
         if jnp.issubdtype(a.dtype, jnp.floating) else a, params)
     draft_params = jax.jit(quantize_params)(params)
+    return model, draft, params, draft_params
 
+
+def run_group(args, model, draft, params, draft_params, arrivals, prompts):
+    """v1 discipline: fixed-width groups, one dispatch per group."""
+    R, B = args.requests, args.max_batch
     # one warmup dispatch compiles the single fixed-width executable
+    warm = jnp.zeros((B, PROMPT), jnp.int32)
     speculative_generate_batched(
-        model, params, draft, draft_params, init_prompt, NEW,
-        n_draft=NDRAFT,
+        model, params, draft, draft_params, warm, NEW, n_draft=NDRAFT,
     ).block_until_ready()
 
-    # simulated request stream: arrival times + prompts
-    arrivals = np.cumsum(
-        rng.exponential(args.arrival_ms / 1e3, size=args.requests)
-    )
-    prompts = rng.integers(0, VOCAB, size=(args.requests, PROMPT))
-
     t0 = time.perf_counter()
-    done_at = np.zeros(args.requests)
-    served = 0
-    batches = 0
-    accepted = drafted = 0
-    while served < args.requests:
+    done_at = np.zeros(R)
+    served = batches = accepted = drafted = 0
+    while served < R:
         now = time.perf_counter() - t0
-        ready = [i for i in range(args.requests)
+        ready = [i for i in range(R)
                  if arrivals[i] <= now and done_at[i] == 0.0]
         if not ready:
             # sleep until the next arrival instead of spinning
@@ -104,11 +104,11 @@ def main():
             if pending.size:
                 time.sleep(float(pending.min() - now) + 1e-4)
             continue
-        group = ready[: args.max_batch]
+        group = ready[:B]
         # pad to the fixed width with repeats of the last real prompt:
         # rows are independent (per-row KV frontiers), so dummy rows
         # cost compute but never touch correctness or other rows
-        rows = group + [group[-1]] * (args.max_batch - len(group))
+        rows = group + [group[-1]] * (B - len(group))
         batch = jnp.asarray(prompts[rows], jnp.int32)
         toks, stats = speculative_generate_batched(
             model, params, draft, draft_params, batch, NEW,
@@ -122,15 +122,131 @@ def main():
         batches += 1
         accepted += int(stats["accepted"][: len(group)].sum())
         drafted += int(stats["drafted"][: len(group)].sum())
-
-    lat = (done_at - arrivals) * 1e3
     total = time.perf_counter() - t0
-    print(f"served {args.requests} requests in {batches} batches "
-          f"({args.requests * NEW / total:.0f} tok/s aggregate)")
-    print(f"latency ms: p50 {np.percentile(lat, 50):.0f}  "
+    return dict(lat=(done_at - arrivals) * 1e3, total=total,
+                dispatches=batches, unit="batches",
+                accepted=accepted, drafted=drafted)
+
+
+def run_continuous(args, model, draft, params, draft_params,
+                   arrivals, prompts):
+    """Round-granular: one speculative round per dispatch; a finished
+    row is re-admitted with the next pending request between rounds,
+    while the other rows keep decoding."""
+    R, B = args.requests, args.max_batch
+    bat = ContinuousBatcher(model, draft, params, draft_params,
+                            total_len=PROMPT + NEW, n_draft=NDRAFT)
+    # warmup compiles prefill + round + admit before the clock starts
+    warm = jnp.zeros((B, PROMPT), jnp.int32)
+    bat.start(warm)
+    bat.step()
+    bat.admit(0, warm[:1])
+    bat.step()
+
+    done_at = np.zeros(R)
+    admitted = np.zeros(R, bool)
+    row_req = [None] * B  # which request occupies each row
+    served = rounds = accepted = drafted = joins = 0
+    t0 = time.perf_counter()
+
+    def now():
+        return time.perf_counter() - t0
+
+    # the batch starts when the first request lands
+    time.sleep(max(0.0, float(arrivals[0])) + 1e-4)
+    group = [i for i in range(R) if arrivals[i] <= now()][:B]
+    rows = group + [group[-1]] * (B - len(group))
+    bat.start(jnp.asarray(prompts[rows], jnp.int32))
+    for r, req in enumerate(group):
+        row_req[r] = req
+        admitted[req] = True
+    for r in range(len(group), B):
+        bat.retire(r)  # pad rows idle (round body skips done rows)
+
+    while served < R:
+        if any(req is not None for req in row_req):
+            bat.step()  # ONE speculative round for every live row
+            rounds += 1
+        else:
+            nxt = arrivals[~admitted]
+            time.sleep(max(0.0, float(nxt.min()) - now()) + 1e-4)
+        t_now = now()
+        stats = bat.stats()
+        for row in bat.finished_rows():
+            req = row_req[row]
+            if req is not None:
+                # per-row counters reset on admit, so read them at
+                # completion, before the slot is recycled
+                done_at[req] = t_now
+                accepted += int(stats["accepted"][row])
+                drafted += int(stats["drafted"][row])
+                row_req[row] = None
+                served += 1
+            pend = [i for i in range(R)
+                    if not admitted[i] and arrivals[i] <= t_now]
+            if pend:
+                nxt_req = pend[0]
+                live = sum(1 for q in row_req if q is not None)
+                bat.admit(row, jnp.asarray(prompts[nxt_req], jnp.int32))
+                row_req[row] = nxt_req
+                admitted[nxt_req] = True
+                if live:
+                    joins += 1
+                    print(f"  [continuous] request {nxt_req} joined row "
+                          f"{row} at round {rounds} — {live} rows still "
+                          f"mid-decode")
+    total = now()
+    return dict(lat=(done_at - arrivals) * 1e3, total=total,
+                dispatches=rounds, unit="rounds",
+                accepted=accepted, drafted=drafted, joins=joins)
+
+
+def _report(name, res, n_requests):
+    lat = res["lat"]
+    print(f"[{name}] served {n_requests} requests in {res['dispatches']} "
+          f"{res['unit']} ({n_requests * NEW / res['total']:.0f} tok/s "
+          f"aggregate)")
+    print(f"[{name}] latency ms: p50 {np.percentile(lat, 50):.0f}  "
           f"p90 {np.percentile(lat, 90):.0f}  max {lat.max():.0f}")
-    print(f"speculative acceptance {accepted / max(drafted, 1):.0%} "
+    print(f"[{name}] speculative acceptance "
+          f"{res['accepted'] / max(res['drafted'], 1):.0%} "
           f"(int8 self-draft, n_draft={NDRAFT})")
+    if "joins" in res:
+        print(f"[{name}] {res['joins']} requests joined a half-finished "
+              f"batch")
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--requests", type=int, default=24)
+    parser.add_argument("--max-batch", type=int, default=8)
+    parser.add_argument("--arrival-ms", type=float, default=30.0,
+                        help="mean simulated inter-arrival gap")
+    parser.add_argument("--mode", choices=("group", "continuous", "both"),
+                        default="both")
+    args = parser.parse_args()
+
+    # ONE seeded trace shared by both modes: identical arrivals and
+    # prompts make the p50s directly comparable
+    rng = np.random.default_rng(0)
+    arrivals = np.cumsum(
+        rng.exponential(args.arrival_ms / 1e3, size=args.requests)
+    )
+    prompts = rng.integers(0, VOCAB, size=(args.requests, PROMPT))
+    model, draft, params, draft_params = _build()
+
+    runners = {"group": run_group, "continuous": run_continuous}
+    modes = ["group", "continuous"] if args.mode == "both" else [args.mode]
+    results = {}
+    for m in modes:
+        results[m] = runners[m](args, model, draft, params, draft_params,
+                                arrivals, prompts)
+        _report(m, results[m], args.requests)
+    if len(results) == 2:
+        g = np.percentile(results["group"]["lat"], 50)
+        c = np.percentile(results["continuous"]["lat"], 50)
+        print(f"per-request p50: continuous {c:.0f} ms vs group {g:.0f} ms "
+              f"({g / max(c, 1e-9):.1f}x lower)")
 
 
 if __name__ == "__main__":

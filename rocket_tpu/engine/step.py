@@ -218,10 +218,22 @@ def build_window_step(
     if window < 1:
         raise ValueError("window must be >= 1")
 
+    def _concat_rows(*xs):
+        # Row-concat via scatter into a zeros buffer instead of
+        # jnp.concatenate: GSPMD mis-partitions batch-dim concats of
+        # sharded operands under a pipe/tensor mesh (the same bug
+        # documented in ops/fused_ce.py padding), silently corrupting
+        # the window's rows before the GPipe pass.
+        n = sum(x.shape[0] for x in xs)
+        out = jnp.zeros((n,) + xs[0].shape[1:], xs[0].dtype)
+        off = 0
+        for x in xs:
+            out = jax.lax.dynamic_update_slice_in_dim(out, x, off, 0)
+            off += x.shape[0]
+        return out
+
     def window_loss(params, mutable, rng, batches: Tuple[Any, ...]):
-        concat = jax.tree_util.tree_map(
-            lambda *xs: jnp.concatenate(xs, axis=0), *batches
-        )
+        concat = jax.tree_util.tree_map(_concat_rows, *batches)
         compute_params = policy.cast_to_compute(params)
         batch_out, new_mutable = apply_fn(
             compute_params, mutable, rng, concat, True

@@ -1,7 +1,9 @@
 from rocket_tpu.models import objectives
 from rocket_tpu.models.layers import Embed, PDense, RMSNorm, apply_rope, rotary_embedding
 from rocket_tpu.models.generate import (
+    ContinuousBatcher,
     beam_search,
+    beam_search_cached,
     beam_search_seq2seq,
     generate,
     generate_seq2seq,
@@ -18,8 +20,10 @@ from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
 from rocket_tpu.models.vit import ViT, ViTConfig
 
 __all__ = [
+    "ContinuousBatcher",
     "Embed",
     "beam_search",
+    "beam_search_cached",
     "beam_search_seq2seq",
     "generate",
     "generate_seq2seq",

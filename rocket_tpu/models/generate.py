@@ -241,6 +241,18 @@ def _set_cache_index(cache: Any, value) -> Any:
 
 
 @functools.partial(jax.jit, static_argnums=0)
+def _prefill_cache(model, params, prompt):
+    """Jitted prompt prefill from a zero cache for the HOST loops:
+    ``(cache, last-position f32 logits [B, V])`` via
+    :func:`_chunked_prefill`, so rolling-cache models chunk by their
+    slack instead of dying in ``_decode_attend``'s chunk-size check on
+    long prompts (the batched path already prefills this way)."""
+    return _chunked_prefill(
+        model, params, zero_cache(model, params, prompt), prompt
+    )
+
+
+@functools.partial(jax.jit, static_argnums=0)
 def _chunk_step(model, params, cache, toks, pos0):
     """Apply ``toks`` ([1, S]) at positions pos0..pos0+S-1; returns
     (cache, greedy next-token per position [1, S]).
@@ -329,15 +341,18 @@ def _speculative_loop(
         # accept d_1..d_j plus the round's extra token (greedy: the
         # target's own next token; sampling: the resample/bonus draw)
         new_toks = (drafts[:j] + [tok])[: max_new_tokens - n_out]
-        stats["rounds"] += 1
-        stats["drafted"] += k
-        stats["accepted"] += j
         finished = eos_token is not None and eos_token in new_toks
         if finished:
             # freeze at eos exactly like generate(): keep the prefix
             # through the first eos, fill the rest of the fixed-length
             # output with eos, and stop decoding
             new_toks = new_toks[: new_toks.index(eos_token) + 1]
+        stats["rounds"] += 1
+        stats["drafted"] += k
+        # accepted counts drafts actually EMITTED, matching the batched
+        # path (min(j, acc) there): an eos/budget-truncated round must
+        # not inflate the acceptance rate
+        stats["accepted"] += min(j, len(new_toks))
         tokens.extend(new_toks)
         n_out += len(new_toks)
         if finished:
@@ -389,14 +404,11 @@ def speculative_generate(
     caches = {}
 
     def prefill():
-        # the target's last-position argmax is the first pending token g
-        caches["t"], t_greedy = target_step(
-            zero_cache(model, params, prompt), prompt, 0
-        )
-        caches["d"], _ = draft_step(
-            zero_cache(draft_model, draft_params, prompt), prompt, 0
-        )
-        return int(np.asarray(t_greedy[0, -1]))
+        # the target's last-position argmax is the first pending token g;
+        # _prefill_cache chunks rolling-cache prompts by their slack
+        caches["t"], last = _prefill_cache(model, params, prompt)
+        caches["d"], _ = _prefill_cache(draft_model, draft_params, prompt)
+        return int(np.asarray(jnp.argmax(last[0])))
 
     def do_round(feed_toks, feed_start, pending, pos, k):
         feed = jnp.asarray(feed_toks, jnp.int32)[None, :]
@@ -463,36 +475,17 @@ def _accept_resample_rows(p_rows: jax.Array, q_rows: jax.Array,
     return j, tok.astype(jnp.int32)
 
 
-@functools.partial(
-    jax.jit, static_argnums=(0, 1),
-    static_argnames=("max_new_tokens", "n_draft", "eos_token", "sampled",
-                     "top_k"),
-)
-def _spec_batched_run(model, draft_model, params, draft_params, prompt,
-                      key=None, temperature=0.0, *, max_new_tokens,
-                      n_draft, eos_token, sampled=False, top_k=None,
-                      top_p=None):
-    """The device-resident round loop behind
-    :func:`speculative_generate_batched` (``sampled=False``: greedy,
-    draft-agreement acceptance) and :func:`speculative_sample_batched`
-    (``sampled=True``: rejection sampling via
-    :func:`_accept_resample_rows`) — one ``lax.while_loop``, zero host
-    syncs until the final result.  ``model``/``draft_model`` must be
-    ``decode_per_row`` variants (rows keep independent frontiers).
-    Static (recompiling) arguments: the boolean mode and ``top_k``
-    (a lax.top_k shape).  ``temperature`` and ``top_p`` are traced
-    operands, so per-request values reuse one compiled executable
-    (top_p's None-ness still splits the cache once).
-
-    Why no cache rewinds: with per-row positions, a stale K/V slot past
-    a row's frontier has a key position larger than every live query
-    position, so the causal mask hides it; the next round's chunk
-    (which always spans at least as far) overwrites it in place before
-    anything can attend to it.
-    """
+def _spec_prefill_impl(model, draft_model, params, draft_params, prompt,
+                       key, temperature, *, max_new_tokens, eos_token,
+                       sampled, top_k, top_p):
+    """Build the speculative round-loop carry state: both prompt
+    prefills plus the first emitted token g.  Returns the state tuple
+    ``(buf, n_tok, done, cache_t, cache_d, key, (rounds, drafted,
+    accepted))`` threaded through :func:`_spec_round_impl` — every leaf
+    stays on device, so a host driver holding the state between rounds
+    pays no transfers."""
     B, P = prompt.shape
     total = P + max_new_tokens
-    k = n_draft
     if key is None:
         key = jax.random.PRNGKey(0)
 
@@ -523,128 +516,191 @@ def _spec_batched_run(model, draft_model, params, draft_params, prompt,
     stats0 = (jnp.zeros((), jnp.int32),      # rounds
               jnp.zeros((B,), jnp.int32),    # drafted per row
               jnp.zeros((B,), jnp.int32))    # accepted per row
+    return buf, n_tok, done, cache_t, cache_d, key, stats0
+
+
+def _spec_round_impl(model, draft_model, params, draft_params, state,
+                     temperature, *, n_draft, eos_token, sampled, top_k,
+                     top_p):
+    """ONE speculative decode round: the fused draft chain, the single
+    target verification forward, accept/emit, and stats — the body of
+    :func:`_spec_batched_run`'s while_loop AND the unit of the step API
+    (:class:`ContinuousBatcher` runs it once per call so requests can
+    join between rounds).  ``state`` is a :func:`_spec_prefill_impl`
+    tuple; batch size and buffer length derive from ``buf``'s shape.
+
+    Why no cache rewinds: with per-row positions, a stale K/V slot past
+    a row's frontier has a key position larger than every live query
+    position, so the causal mask hides it; the next round's chunk
+    (which always spans at least as far) overwrites it in place before
+    anything can attend to it.  The same masking argument admits a NEW
+    request into a retired row mid-batch (:func:`_spec_admit`): the old
+    request's leftover K/V beyond the fresh prompt are invisible to it.
+    """
+    (buf, n_tok, done_in, cache_t, cache_d, key_in,
+     (rounds, drafted, accepted)) = state
+    B, total = buf.shape
+    k = n_draft
     ar = jnp.arange(k + 1, dtype=jnp.int32)[None, :]
+    key_draft, key_accept, key_out = jax.random.split(key_in, 3)
+    pos = n_tok - 1                                     # [B] frontiers
+    pending = jnp.take_along_axis(buf, pos[:, None], axis=1)[:, 0]
+
+    # Draft chain, fused: k+1 single-token steps under ONE scan.
+    # Step i processes chunk token C_i at position pos+i and proposes
+    # C_{i+1}; the extra (k+1)-th step exists so the draft cache
+    # always covers the whole chunk — no catch-up feed next round.
+    def draft_step(carry, xs):
+        cache_d, tok = carry
+        i, ki = xs
+        out, mut = draft_model.apply(
+            {"params": draft_params, "cache": cache_d},
+            {"tokens": tok[:, None], "positions": (pos + i)[:, None]},
+            decode=True, mutable=["cache"],
+        )
+        logits = out["logits"][:, 0].astype(jnp.float32)
+        if sampled:
+            # truncated-renormalized q: the accept/resample theorem
+            # holds for ANY q as long as p and q are the actual
+            # proposal/verify distributions — truncating both makes
+            # the emitted tokens exactly truncated-target-distributed
+            logits = _truncate_logits(logits / temperature, top_k, top_p)
+            nxt = jax.random.categorical(
+                ki, logits, axis=-1).astype(jnp.int32)
+            q_row = jax.nn.softmax(logits, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            q_row = jnp.zeros((B, 0), jnp.float32)  # unused
+        return (mut["cache"], nxt), (tok, q_row)
+
+    (cache_d, _), (chunk_t, q_t) = jax.lax.scan(
+        draft_step, (cache_d, pending),
+        (jnp.arange(k + 1, dtype=jnp.int32),
+         jax.random.split(key_draft, k + 1)),
+    )
+    chunk = chunk_t.swapaxes(0, 1)        # [B, k+1]: [pending, d_1..d_k]
+    drafts = chunk[:, 1:]                 # [B, k]
+
+    # ONE target forward verifies every row's whole chunk
+    out, mut = model.apply(
+        {"params": params, "cache": cache_t},
+        {"tokens": chunk, "positions": pos[:, None] + ar},
+        decode=True, mutable=["cache"],
+    )
+    cache_t = mut["cache"]
+    t_logits = out["logits"].astype(jnp.float32)        # [B, k+1, V]
+
+    if sampled:
+        # rejection sampling: accept d_i with prob min(1, p/q); the
+        # emitted tokens are the accepted DRAFTS plus the round's
+        # resample/bonus draw
+        p_rows = jax.nn.softmax(
+            _truncate_logits(t_logits / temperature, top_k, top_p),
+            axis=-1,
+        )
+        q_rows = q_t[:k].swapaxes(0, 1)                 # [B, k, V]
+        j, tok = _accept_resample_rows(
+            p_rows, q_rows, drafts, key_accept)
+        vals = jnp.where(
+            ar < j[:, None],
+            jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
+            tok[:, None],
+        )
+    else:
+        # greedy: leading draft/argmax agreement; the accepted drafts
+        # ARE the target's own argmaxes, so each row's new tokens are
+        # simply y[:, :j+1] (bonus/correction token included)
+        y = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
+        match = (drafts == y[:, :k]).astype(jnp.int32)
+        j = jnp.cumprod(match, axis=1).sum(axis=1)      # [B], 0..k
+        vals = y
+
+    keep = ar <= j[:, None]
+    if eos_token is not None:
+        # freeze at the first emitted eos: keep through it, drop after
+        no_eos_before = jnp.cumprod(jnp.concatenate(
+            [jnp.ones((B, 1), jnp.int32),
+             (vals[:, :k] != eos_token).astype(jnp.int32)], axis=1,
+        ), axis=1).astype(bool)
+        keep = keep & no_eos_before
+    keep = keep & ((n_tok[:, None] + ar) < total) & ~done_in[:, None]
+
+    cols = jnp.where(keep, n_tok[:, None] + ar, total)  # OOB -> dropped
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], cols.shape)
+    buf = buf.at[rows, cols].set(vals, mode="drop")
+
+    acc = keep.sum(axis=1).astype(jnp.int32)
+    n_tok = n_tok + acc
+    done = done_in | (n_tok >= total)
+    if eos_token is not None:
+        done = done | jnp.any((vals == eos_token) & keep, axis=1)
+    active = ~done_in
+    # Stats mirror the host loop's semantics: drafted clamps to the
+    # row's remaining token budget (the B=1 loop shortens its last
+    # draft chain the same way), and accepted counts drafts actually
+    # EMITTED — of the acc written tokens the first min(j, acc) are
+    # draft proposals, the rest is the bonus/correction token.  A
+    # total-cap or eos truncation must not inflate the rate.
+    remaining = total - (n_tok - acc)  # budget at round START
+    stats = (rounds + 1,
+             drafted + jnp.where(active, jnp.minimum(k, remaining), 0),
+             accepted + jnp.where(active, jnp.minimum(j, acc), 0))
+    return buf, n_tok, done, cache_t, cache_d, key_out, stats
+
+
+def _spec_eos_fill(buf, n_tok, eos_token):
+    """Fixed-length contract: eos-frozen rows fill their tail with eos
+    (rows without an eos ended at ``n_tok == total`` — no-op for them)."""
+    if eos_token is None:
+        return buf
+    cols = jnp.arange(buf.shape[1], dtype=jnp.int32)[None, :]
+    return jnp.where(cols >= n_tok[:, None], eos_token, buf)
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 1),
+    static_argnames=("max_new_tokens", "n_draft", "eos_token", "sampled",
+                     "top_k"),
+)
+def _spec_batched_run(model, draft_model, params, draft_params, prompt,
+                      key=None, temperature=0.0, *, max_new_tokens,
+                      n_draft, eos_token, sampled=False, top_k=None,
+                      top_p=None):
+    """The device-resident round loop behind
+    :func:`speculative_generate_batched` (``sampled=False``: greedy,
+    draft-agreement acceptance) and :func:`speculative_sample_batched`
+    (``sampled=True``: rejection sampling via
+    :func:`_accept_resample_rows`) — one ``lax.while_loop`` over
+    :func:`_spec_round_impl`, zero host syncs until the final result.
+    ``model``/``draft_model`` must be ``decode_per_row`` variants (rows
+    keep independent frontiers).  The prefill/round pieces are shared
+    with the step API (:func:`_spec_prefill` / :func:`_spec_round`), so
+    the one-dispatch offline path and the round-granular serving path
+    cannot drift.
+
+    Static (recompiling) arguments: the boolean mode and ``top_k``
+    (a lax.top_k shape).  ``temperature`` and ``top_p`` are traced
+    operands, so per-request values reuse one compiled executable
+    (top_p's None-ness still splits the cache once).
+    """
+    state = _spec_prefill_impl(
+        model, draft_model, params, draft_params, prompt, key, temperature,
+        max_new_tokens=max_new_tokens, eos_token=eos_token, sampled=sampled,
+        top_k=top_k, top_p=top_p,
+    )
 
     def cond(state):
         return ~jnp.all(state[2])
 
     def body(state):
-        (buf, n_tok, done_in, cache_t, cache_d, key_in,
-         (rounds, drafted, accepted)) = state
-        key_draft, key_accept, key_out = jax.random.split(key_in, 3)
-        pos = n_tok - 1                                     # [B] frontiers
-        pending = jnp.take_along_axis(buf, pos[:, None], axis=1)[:, 0]
-
-        # Draft chain, fused: k+1 single-token steps under ONE scan.
-        # Step i processes chunk token C_i at position pos+i and proposes
-        # C_{i+1}; the extra (k+1)-th step exists so the draft cache
-        # always covers the whole chunk — no catch-up feed next round.
-        def draft_step(carry, xs):
-            cache_d, tok = carry
-            i, ki = xs
-            out, mut = draft_model.apply(
-                {"params": draft_params, "cache": cache_d},
-                {"tokens": tok[:, None], "positions": (pos + i)[:, None]},
-                decode=True, mutable=["cache"],
-            )
-            logits = out["logits"][:, 0].astype(jnp.float32)
-            if sampled:
-                # truncated-renormalized q: the accept/resample theorem
-                # holds for ANY q as long as p and q are the actual
-                # proposal/verify distributions — truncating both makes
-                # the emitted tokens exactly truncated-target-distributed
-                logits = _truncate_logits(logits / temperature, top_k, top_p)
-                nxt = jax.random.categorical(
-                    ki, logits, axis=-1).astype(jnp.int32)
-                q_row = jax.nn.softmax(logits, axis=-1)
-            else:
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                q_row = jnp.zeros((B, 0), jnp.float32)  # unused
-            return (mut["cache"], nxt), (tok, q_row)
-
-        (cache_d, _), (chunk_t, q_t) = jax.lax.scan(
-            draft_step, (cache_d, pending),
-            (jnp.arange(k + 1, dtype=jnp.int32),
-             jax.random.split(key_draft, k + 1)),
+        return _spec_round_impl(
+            model, draft_model, params, draft_params, state, temperature,
+            n_draft=n_draft, eos_token=eos_token, sampled=sampled,
+            top_k=top_k, top_p=top_p,
         )
-        chunk = chunk_t.swapaxes(0, 1)        # [B, k+1]: [pending, d_1..d_k]
-        drafts = chunk[:, 1:]                 # [B, k]
 
-        # ONE target forward verifies every row's whole chunk
-        out, mut = model.apply(
-            {"params": params, "cache": cache_t},
-            {"tokens": chunk, "positions": pos[:, None] + ar},
-            decode=True, mutable=["cache"],
-        )
-        cache_t = mut["cache"]
-        t_logits = out["logits"].astype(jnp.float32)        # [B, k+1, V]
-
-        if sampled:
-            # rejection sampling: accept d_i with prob min(1, p/q); the
-            # emitted tokens are the accepted DRAFTS plus the round's
-            # resample/bonus draw
-            p_rows = jax.nn.softmax(
-                _truncate_logits(t_logits / temperature, top_k, top_p),
-                axis=-1,
-            )
-            q_rows = q_t[:k].swapaxes(0, 1)                 # [B, k, V]
-            j, tok = _accept_resample_rows(
-                p_rows, q_rows, drafts, key_accept)
-            vals = jnp.where(
-                ar < j[:, None],
-                jnp.concatenate([drafts, drafts[:, -1:]], axis=1),
-                tok[:, None],
-            )
-        else:
-            # greedy: leading draft/argmax agreement; the accepted drafts
-            # ARE the target's own argmaxes, so each row's new tokens are
-            # simply y[:, :j+1] (bonus/correction token included)
-            y = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)
-            match = (drafts == y[:, :k]).astype(jnp.int32)
-            j = jnp.cumprod(match, axis=1).sum(axis=1)      # [B], 0..k
-            vals = y
-
-        keep = ar <= j[:, None]
-        if eos_token is not None:
-            # freeze at the first emitted eos: keep through it, drop after
-            no_eos_before = jnp.cumprod(jnp.concatenate(
-                [jnp.ones((B, 1), jnp.int32),
-                 (vals[:, :k] != eos_token).astype(jnp.int32)], axis=1,
-            ), axis=1).astype(bool)
-            keep = keep & no_eos_before
-        keep = keep & ((n_tok[:, None] + ar) < total) & ~done_in[:, None]
-
-        cols = jnp.where(keep, n_tok[:, None] + ar, total)  # OOB -> dropped
-        rows = jnp.broadcast_to(jnp.arange(B)[:, None], cols.shape)
-        buf = buf.at[rows, cols].set(vals, mode="drop")
-
-        acc = keep.sum(axis=1).astype(jnp.int32)
-        n_tok = n_tok + acc
-        done = done_in | (n_tok >= total)
-        if eos_token is not None:
-            done = done | jnp.any((vals == eos_token) & keep, axis=1)
-        active = ~done_in
-        # Stats mirror the host loop's semantics: drafted clamps to the
-        # row's remaining token budget (the B=1 loop shortens its last
-        # draft chain the same way), and accepted counts drafts actually
-        # EMITTED — of the acc written tokens the first min(j, acc) are
-        # draft proposals, the rest is the bonus/correction token.  A
-        # total-cap or eos truncation must not inflate the rate.
-        remaining = total - (n_tok - acc)  # budget at round START
-        stats = (rounds + 1,
-                 drafted + jnp.where(active, jnp.minimum(k, remaining), 0),
-                 accepted + jnp.where(active, jnp.minimum(j, acc), 0))
-        return buf, n_tok, done, cache_t, cache_d, key_out, stats
-
-    buf, n_tok, done, _, _, _, stats = jax.lax.while_loop(
-        cond, body, (buf, n_tok, done, cache_t, cache_d, key, stats0)
-    )
-    if eos_token is not None:
-        # fixed-length contract: eos-frozen rows fill their tail with eos
-        # (rows without an eos ended at n_tok == total — no-op for them)
-        cols = jnp.arange(total, dtype=jnp.int32)[None, :]
-        buf = jnp.where(cols >= n_tok[:, None], eos_token, buf)
-    return buf, stats
+    buf, n_tok, done, _, _, _, stats = jax.lax.while_loop(cond, body, state)
+    return _spec_eos_fill(buf, n_tok, eos_token), stats
 
 
 def _spec_batched_call(model, draft_model, params, draft_params, prompt,
@@ -793,6 +849,283 @@ def speculative_sample_batched(
     )
 
 
+@functools.partial(
+    jax.jit, static_argnums=(0, 1),
+    static_argnames=("max_new_tokens", "eos_token", "sampled", "top_k"),
+)
+def _spec_prefill(model, draft_model, params, draft_params, prompt,
+                  key=None, temperature=0.0, *, max_new_tokens, eos_token,
+                  sampled=False, top_k=None, top_p=None):
+    """Jitted step-API entry: prefill a fresh batch and return the
+    device-resident round state (see :func:`_spec_prefill_impl`)."""
+    return _spec_prefill_impl(
+        model, draft_model, params, draft_params, prompt, key, temperature,
+        max_new_tokens=max_new_tokens, eos_token=eos_token, sampled=sampled,
+        top_k=top_k, top_p=top_p,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 1),
+    static_argnames=("n_draft", "eos_token", "sampled", "top_k"),
+)
+def _spec_round(model, draft_model, params, draft_params, state,
+                temperature=0.0, *, n_draft, eos_token, sampled=False,
+                top_k=None, top_p=None):
+    """Jitted step-API entry: execute ONE speculative decode round on a
+    :func:`_spec_prefill` state.  Module-level jit with the (hashable)
+    flax modules static: a serving loop pays one compile per (model,
+    batch shape), then every round is a single cheap dispatch."""
+    return _spec_round_impl(
+        model, draft_model, params, draft_params, state, temperature,
+        n_draft=n_draft, eos_token=eos_token, sampled=sampled,
+        top_k=top_k, top_p=top_p,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnums=(0, 1),
+    static_argnames=("eos_token", "sampled", "top_k"),
+)
+def _spec_admit(model, draft_model, params, draft_params, state, row,
+                prompt_row, key=None, temperature=0.0, *, eos_token,
+                sampled=False, top_k=None, top_p=None):
+    """Admit ONE new request into row ``row`` of a half-finished batch
+    between rounds: prefill its prompt at batch 1, scatter the K/V rows
+    into the batch caches, and reset the row's buffer / frontier / done
+    flag / per-row stats.  The other rows' state is untouched — they
+    continue decoding next round as if nothing happened.
+
+    Stale K/V the previous occupant left beyond the fresh prompt need no
+    clearing: with per-row frontiers their key positions exceed every
+    query position the new request will ever issue below them, so the
+    causal mask hides them until the new request overwrites them in
+    place (the same no-rewind argument as :func:`_spec_round_impl`).
+    """
+    (buf, n_tok, done, cache_t, cache_d, key_st,
+     (rounds, drafted, accepted)) = state
+    total = buf.shape[1]
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    P_new = prompt_row.shape[1]
+
+    c1_t, last = _chunked_prefill(
+        model, params, zero_cache(model, params, prompt_row), prompt_row
+    )
+    c1_d, _ = _chunked_prefill(
+        draft_model, draft_params,
+        zero_cache(draft_model, draft_params, prompt_row), prompt_row
+    )
+    if sampled:
+        key, kg = jax.random.split(key)
+        g = jax.random.categorical(
+            kg, _truncate_logits(last / temperature, top_k, top_p),
+            axis=-1,
+        ).astype(jnp.int32)[0]
+    else:
+        g = jnp.argmax(last, axis=-1).astype(jnp.int32)[0]
+
+    row_buf = jnp.zeros((total,), jnp.int32)
+    row_buf = jax.lax.dynamic_update_slice(row_buf, prompt_row[0], (0,))
+    row_buf = row_buf.at[P_new].set(g)
+    buf = buf.at[row].set(row_buf)
+    n_tok = n_tok.at[row].set(P_new + 1)
+    row_done = (g == eos_token) if eos_token is not None \
+        else jnp.asarray(False)
+    done = done.at[row].set(row_done)
+
+    def scatter(batch_cache, one_cache):
+        # K/V leaves [B, slots, KV, D] take the fresh row; the scalar
+        # cache_index is bookkeeping only under per-row frontiers — keep
+        # it monotone so rolling-cache chunk math stays conservative
+        return jax.tree_util.tree_map(
+            lambda a, b: a.at[row].set(b[0]) if getattr(a, "ndim", 0) == 4
+            else jnp.maximum(a, b),
+            batch_cache, one_cache,
+        )
+
+    cache_t = scatter(cache_t, c1_t)
+    cache_d = scatter(cache_d, c1_d)
+    drafted = drafted.at[row].set(0)
+    accepted = accepted.at[row].set(0)
+    return (buf, n_tok, done, cache_t, cache_d, key_st,
+            (rounds, drafted, accepted))
+
+
+class ContinuousBatcher:
+    """Round-granular continuous batching over the batched speculative
+    decoder — the serving-loop counterpart of the one-dispatch
+    :func:`speculative_generate_batched`.
+
+    The one-dispatch path pads whole request groups: a new arrival waits
+    for the current group's SLOWEST row before any of its tokens exist.
+    This driver runs the identical round body one call at a time
+    (:func:`_spec_round` — same :func:`_spec_round_impl` the while_loop
+    uses, behind a persistent module-level jit), keeping the carry state
+    on device between calls, so the host can admit a fresh request into
+    a finished row between rounds (:meth:`admit`) while the other rows
+    keep decoding.  Driving :meth:`step` until every row finishes
+    reproduces the one-dispatch output bit for bit (tested): both paths
+    run the same prefill and round computations in the same order with
+    the same key threading.
+
+    Typical serving loop::
+
+        b = ContinuousBatcher(model, draft, params, dparams, total_len=T)
+        b.start(prompts)                    # [B, P] first group
+        while requests_pending_or_decoding:
+            b.step()                        # ONE speculative round
+            for row in b.finished_rows():
+                tokens, n = b.row_tokens(row)
+                b.admit(row, next_prompt)   # joins the live batch
+
+    ``total_len`` is the fixed per-row buffer length (prompt + output);
+    every admitted prompt needs ``len(prompt) + 1 <= total_len`` and the
+    models need ``total_len + n_draft <= max_seq`` (verify-chunk slack,
+    same rule as the one-dispatch path).
+    """
+
+    def __init__(self, model, draft_model, params, draft_params, *,
+                 total_len, n_draft=4, eos_token=None, sampled=False,
+                 temperature=0.0, top_k=None, top_p=None, rng=None):
+        import dataclasses
+
+        if n_draft < 1:
+            raise ValueError(f"n_draft must be >= 1, got {n_draft}")
+        if sampled and temperature <= 0.0:
+            raise ValueError(
+                "sampled=True needs temperature > 0; use sampled=False "
+                "for greedy decoding"
+            )
+        for m, label in ((model, "model"), (draft_model, "draft_model")):
+            if total_len + n_draft > m.config.max_seq:
+                raise ValueError(
+                    f"total_len ({total_len}) + n_draft ({n_draft}) = "
+                    f"{total_len + n_draft} exceeds {label}'s max_seq "
+                    f"({m.config.max_seq}); the verify chunk can write up "
+                    f"to n_draft slots past the final token"
+                )
+            if (getattr(m.config, "decode_rolling_cache", False)
+                    and n_draft + 1 > m.config.decode_rolling_slack):
+                raise ValueError(
+                    f"n_draft + 1 = {n_draft + 1} exceeds {label}'s "
+                    f"decode_rolling_slack "
+                    f"({m.config.decode_rolling_slack})"
+                )
+        per_row = lambda m: type(m)(  # noqa: E731
+            dataclasses.replace(m.config, decode_per_row=True)
+        )
+        self._model = per_row(model)
+        self._draft_model = per_row(draft_model)
+        self._params = params
+        self._draft_params = draft_params
+        self.total_len = int(total_len)
+        self.n_draft = int(n_draft)
+        self.eos_token = eos_token
+        self.sampled = bool(sampled)
+        self._temperature = (
+            jnp.float32(temperature) if sampled else temperature
+        )
+        self._top_k = top_k
+        self._top_p = None if top_p is None else jnp.float32(top_p)
+        self._rng = rng if rng is not None else jax.random.PRNGKey(0)
+        self._admits = 0
+        self.state = None
+
+    def _kw(self):
+        return dict(eos_token=self.eos_token, sampled=self.sampled,
+                    top_k=self._top_k, top_p=self._top_p)
+
+    def start(self, prompts) -> None:
+        """Prefill the first group (``[B, P]`` int32) and build the
+        device-resident round state."""
+        B, P = prompts.shape
+        if P + 1 > self.total_len:
+            raise ValueError(
+                f"prompt length {P} + 1 exceeds total_len "
+                f"({self.total_len})"
+            )
+        self.state = _spec_prefill(
+            self._model, self._draft_model, self._params,
+            self._draft_params, prompts, self._rng, self._temperature,
+            max_new_tokens=self.total_len - P, **self._kw(),
+        )
+
+    def step(self):
+        """Run ONE speculative round on every live row; returns
+        ``(n_tok [B], done [B])`` as host numpy arrays."""
+        if self.state is None:
+            raise ValueError("call start() before step()")
+        self.state = _spec_round(
+            self._model, self._draft_model, self._params,
+            self._draft_params, self.state, self._temperature,
+            n_draft=self.n_draft, **self._kw(),
+        )
+        return np.asarray(self.state[1]), np.asarray(self.state[2])
+
+    def admit(self, row: int, prompt_row) -> None:
+        """Replace row ``row`` with a fresh request (``[1, P]`` or
+        ``[P]`` int32) — between rounds, while other rows keep decoding.
+        Admit only rows that are done (or that you mean to preempt): the
+        previous occupant's state is overwritten."""
+        if self.state is None:
+            raise ValueError("call start() before admit()")
+        prompt_row = jnp.asarray(prompt_row, jnp.int32)
+        if prompt_row.ndim == 1:
+            prompt_row = prompt_row[None, :]
+        if prompt_row.shape[1] + 1 > self.total_len:
+            raise ValueError(
+                f"prompt length {prompt_row.shape[1]} + 1 exceeds "
+                f"total_len ({self.total_len})"
+            )
+        self._admits += 1
+        key = jax.random.fold_in(self._rng, self._admits)
+        self.state = _spec_admit(
+            self._model, self._draft_model, self._params,
+            self._draft_params, self.state, jnp.int32(row), prompt_row,
+            key, self._temperature, **self._kw(),
+        )
+
+    def retire(self, row: int) -> None:
+        """Mark a row done without admitting a replacement — its slot
+        idles (the round body skips done rows) until the next admit."""
+        if self.state is None:
+            raise ValueError("call start() before retire()")
+        (buf, n_tok, done, cache_t, cache_d, key, stats) = self.state
+        self.state = (buf, n_tok, done.at[row].set(True), cache_t,
+                      cache_d, key, stats)
+
+    def finished_rows(self):
+        """Row indices whose requests are complete (eos or full buffer)."""
+        if self.state is None:
+            return []
+        return [int(r) for r in np.nonzero(np.asarray(self.state[2]))[0]]
+
+    @property
+    def all_done(self) -> bool:
+        return self.state is not None and bool(np.all(np.asarray(
+            self.state[2])))
+
+    def row_tokens(self, row: int):
+        """``(tokens [total_len], n_tok)`` for one row, eos-tail-filled
+        to the fixed-length contract of the one-dispatch path."""
+        if self.state is None:
+            raise ValueError("call start() before row_tokens()")
+        buf, n_tok = self.state[0], self.state[1]
+        filled = _spec_eos_fill(buf, n_tok, self.eos_token)
+        return np.asarray(filled[row]), int(n_tok[row])
+
+    def stats(self):
+        """``{"rounds": int, "drafted": [B], "accepted": [B]}`` — same
+        shape as the one-dispatch ``return_stats`` payload.  Per-row
+        counters reset when a row is re-admitted."""
+        if self.state is None:
+            raise ValueError("call start() before stats()")
+        rounds, drafted, accepted = self.state[6]
+        return {"rounds": int(rounds), "drafted": np.asarray(drafted),
+                "accepted": np.asarray(accepted)}
+
+
 @functools.partial(jax.jit, static_argnums=0, static_argnames=("temperature",))
 def _chunk_probs(model, params, cache, toks, pos0, *, temperature=1.0):
     """Like :func:`_chunk_step` but returns the full next-token
@@ -858,13 +1191,13 @@ def speculative_sample(
     caches = {}
 
     def prefill():
-        caches["t"], t_probs = target_step(
-            zero_cache(model, params, prompt), prompt, 0
-        )
-        caches["d"], _ = draft_step(
-            zero_cache(draft_model, draft_params, prompt), prompt, 0
-        )
-        row = _norm_row(np.asarray(t_probs[0, -1]))  # device-slice first
+        # _prefill_cache chunks rolling-cache prompts by their slack;
+        # softmax over the last-position row matches _chunk_probs' slice
+        caches["t"], last = _prefill_cache(model, params, prompt)
+        caches["d"], _ = _prefill_cache(draft_model, draft_params, prompt)
+        row = _norm_row(np.asarray(
+            jax.nn.softmax(last[0] / temperature)
+        ))
         return int(host.choice(row.shape[0], p=row))
 
     def do_round(feed_toks, feed_start, pending, pos, k):
@@ -939,6 +1272,38 @@ def _accept_resample(p_rows: "np.ndarray", q_rows: "np.ndarray",
     return k, int(rng.choice(V, p=_norm_row(p_rows[k])))
 
 
+def _validate_beam_lm(model, P, max_new_tokens, beam_size):
+    """Shared loud validation for the decoder-only beam entry points."""
+    if not model.config.causal:
+        raise ValueError(
+            "beam search requires a causal decoder "
+            "(model.config.causal=True): with bidirectional attention the "
+            "still-pad tail of the static buffer leaks into the frontier "
+            "logits and the search silently returns garbage"
+        )
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    if beam_size < 1:
+        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
+    total = P + max_new_tokens
+    if total > model.config.max_seq:
+        raise ValueError(
+            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) = {total} "
+            f"exceeds config.max_seq ({model.config.max_seq})"
+        )
+    return total
+
+
+def _beam_buf(prompt, beam_size, max_new_tokens, pad_id):
+    """``[B, K, P + T]`` token buffer: prompt tiled beam-wise, pad tail."""
+    B, P = prompt.shape
+    buf = jnp.broadcast_to(prompt[:, None], (B, beam_size, P))
+    return jnp.concatenate(
+        [buf, jnp.full((B, beam_size, max_new_tokens), pad_id, jnp.int32)],
+        axis=2,
+    )
+
+
 def beam_search(
     model: Any,
     params: Any,
@@ -961,27 +1326,20 @@ def beam_search(
     unchanged score; final ranking uses the GNMT length penalty
     ``((5 + len) / 6) ** length_penalty``.
 
+    This is the serving path's bit-equality ORACLE: each step pays a
+    full ``P + T``-long forward, so it is O(T) full re-decodes.
+    :func:`beam_search_cached` produces the same tokens from one prompt
+    prefill plus O(T) single-token cached forwards — use that for
+    serving and this for verification.
+
     Returns ``(tokens [B, P + T], scores [B])`` — the best beam per row
     and its length-normalized log-probability.  ``beam_size=1``
     reproduces greedy :func:`generate` decoding (tested).
     """
     B, P = prompt.shape
-    K, V = beam_size, model.config.vocab_size
-    if max_new_tokens < 1:
-        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
-    if K < 1:
-        raise ValueError(f"beam_size must be >= 1, got {beam_size}")
-    total = P + max_new_tokens
-    if total > model.config.max_seq:
-        raise ValueError(
-            f"prompt ({P}) + max_new_tokens ({max_new_tokens}) = {total} "
-            f"exceeds config.max_seq ({model.config.max_seq})"
-        )
-
-    buf = jnp.broadcast_to(prompt[:, None], (B, K, P))
-    buf = jnp.concatenate(
-        [buf, jnp.full((B, K, max_new_tokens), pad_id, jnp.int32)], axis=2
-    )
+    K = beam_size
+    _validate_beam_lm(model, P, max_new_tokens, K)
+    buf = _beam_buf(prompt, K, max_new_tokens, pad_id)
 
     def frontier_logits(flat_buf, t):
         out = model.apply(
@@ -991,21 +1349,160 @@ def beam_search(
             out["logits"], P - 1 + t, 1, axis=1
         )[:, 0]
 
-    return _beam_loop(frontier_logits, buf, P, V, max_new_tokens,
+    return _beam_loop(frontier_logits, buf, P, max_new_tokens,
                       eos_id, pad_id, length_penalty)
 
 
-def _beam_loop(frontier_logits, buf, write_at, V, max_new_tokens,
+def beam_search_cached(
+    model: Any,
+    params: Any,
+    prompt: jax.Array,
+    max_new_tokens: int,
+    eos_id: int,
+    beam_size: int = 4,
+    length_penalty: float = 0.6,
+    pad_id: int = 0,
+) -> tuple:
+    """KV-cached beam search — same results as :func:`beam_search`,
+    O(T) single-token forwards instead of O(T) full re-decodes.
+
+    All K beams share ONE prompt prefill (:func:`_chunked_prefill` at
+    batch ``B``; the cache is tiled beam-wise afterwards, so the prompt
+    is never recomputed per beam).  Each subsequent step runs a single
+    cached forward over the ``[B*K, 1]`` frontier tokens, expands with
+    the shared :func:`_beam_expand` machinery, and reorders the K/V
+    cache rows with the SAME ``src_beam`` gather that reorders the token
+    buffer — a beam that survives carries its cache history with it.
+    Frozen (eos) beams keep decoding their ``pad_id`` continuations into
+    the cache exactly as the oracle's buffer holds them, so the visible
+    prefix — and therefore every logit — matches the re-decode path.
+
+    Decode work per output token drops from one ``P + T``-long forward
+    to one single-token forward: the prompt's K/V are computed once and
+    read T times, which is the whole point of serving from a cache
+    (decode is bandwidth-bound — see ``bench.bench_gpt2_decode``).
+
+    Returns ``(tokens [B, P + T], scores [B])``, matching
+    :func:`beam_search` on the same inputs (tested bit-for-bit on the
+    seed oracles).
+    """
+    B, P = prompt.shape
+    K = beam_size
+    _validate_beam_lm(model, P, max_new_tokens, K)
+    buf = _beam_buf(prompt, K, max_new_tokens, pad_id)
+    V = model.config.vocab_size
+
+    # ONE prefill at batch B; every beam then shares its row's prompt K/V
+    cache, last = _chunked_prefill(
+        model, params, zero_cache(model, params, prompt), prompt
+    )
+    # tile [B, slots, KV, D] -> [B*K, ...] matching buf.reshape(B*K, ...)
+    # row order; the scalar cache_index stays shared (uniform frontiers)
+    cache = jax.tree_util.tree_map(
+        lambda a: jnp.repeat(a, K, axis=0) if getattr(a, "ndim", 0) == 4
+        else a,
+        cache,
+    )
+    row0 = jnp.arange(B, dtype=jnp.int32)[:, None] * K  # [B, 1]
+
+    def gather_cache(cache, src_beam):
+        flat = (row0 + src_beam).reshape(-1)
+        return jax.tree_util.tree_map(
+            lambda a: a[flat] if getattr(a, "ndim", 0) == 4 else a, cache
+        )
+
+    scores = jnp.full((B, K), -jnp.inf).at[:, 0].set(0.0)
+    finished = jnp.zeros((B, K), bool)
+    lengths = jnp.zeros((B, K), jnp.int32)
+
+    # step 0 expands straight from the prefill's frontier logits — the
+    # oracle's t=0 full forward reads the same position-(P-1) logits
+    logits0 = jnp.broadcast_to(last[:, None], (B, K, V)).reshape(B * K, V)
+    buf, scores, finished, lengths, src0 = _beam_expand(
+        logits0, buf, scores, finished, lengths, P, eos_id, pad_id
+    )
+    cache = gather_cache(cache, src0)
+
+    def step(carry, t):
+        cache, buf, scores, finished, lengths = carry
+        # feed the token written at P+t-1; the scalar cache frontier is
+        # already P+t-1, so the single-token write lands in its slot
+        tok = jax.lax.dynamic_slice_in_dim(
+            buf, P + t - 1, 1, axis=2
+        ).reshape(B * K, 1)
+        pos = jnp.broadcast_to(
+            jnp.asarray(P - 1 + t, jnp.int32)[None, None], (B * K, 1)
+        )
+        out, mutated = model.apply(
+            {"params": params, "cache": cache},
+            {"tokens": tok, "positions": pos},
+            decode=True, mutable=["cache"],
+        )
+        buf, scores, finished, lengths, src_beam = _beam_expand(
+            out["logits"][:, 0], buf, scores, finished, lengths, P + t,
+            eos_id, pad_id,
+        )
+        cache = gather_cache(mutated["cache"], src_beam)
+        return (cache, buf, scores, finished, lengths), None
+
+    (cache, buf, scores, finished, lengths), _ = jax.lax.scan(
+        step, (cache, buf, scores, finished, lengths),
+        jnp.arange(1, max_new_tokens),
+    )
+    return _beam_finalize(buf, scores, lengths, length_penalty)
+
+
+def _beam_expand(logits_t, buf, scores, finished, lengths, write_pos,
+                 eos_id, pad_id):
+    """One beam-expansion step, shared by every beam variant: K*V top-k
+    over ``scores + log_softmax(logits_t)`` with frozen-beam pad
+    continuations, gather of the per-beam state by the winning source
+    beams, frontier token write at ``write_pos``, and eos/length
+    accounting.  ``logits_t`` is ``[B*K, V]``.  Returns ``(buf, scores,
+    finished, lengths, src_beam)`` — ``src_beam [B, K]`` so cached
+    variants can reorder their K/V rows with the same gather."""
+    B, K, total = buf.shape
+    V = logits_t.shape[-1]
+    logp = jax.nn.log_softmax(
+        logits_t.astype(jnp.float32), axis=-1
+    ).reshape(B, K, V)
+    # finished beams: only the pad continuation, at unchanged score
+    frozen = jnp.full((V,), -jnp.inf).at[pad_id].set(0.0)
+    logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
+    cand = scores[:, :, None] + logp  # [B, K, V]
+    top_scores, top_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
+    src_beam = top_idx // V  # which beam each winner extends
+    token = (top_idx % V).astype(jnp.int32)
+    buf = jnp.take_along_axis(buf, src_beam[:, :, None], axis=1)
+    finished = jnp.take_along_axis(finished, src_beam, axis=1)
+    lengths = jnp.take_along_axis(lengths, src_beam, axis=1)
+    buf = jax.lax.dynamic_update_slice_in_dim(
+        buf, token[:, :, None], write_pos, axis=2
+    )
+    lengths = jnp.where(finished, lengths, lengths + 1)
+    finished = finished | (token == eos_id)
+    return buf, top_scores, finished, lengths, src_beam
+
+
+def _beam_finalize(buf, scores, lengths, length_penalty):
+    """GNMT length-normalized ranking; best beam per row."""
+    norm = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** length_penalty
+    final = scores / norm
+    best = jnp.argmax(final, axis=1)
+    tokens = jnp.take_along_axis(buf, best[:, None, None], axis=1)[:, 0]
+    return tokens, jnp.take_along_axis(final, best[:, None], axis=1)[:, 0]
+
+
+def _beam_loop(frontier_logits, buf, write_at, max_new_tokens,
                eos_id, pad_id, length_penalty):
-    """Shared beam machinery for both families (:func:`beam_search`,
-    :func:`beam_search_seq2seq`): the K*V top-k expansion with
-    frozen-beam pad continuations, beam reordering, eos/length
-    accounting, and GNMT-normalized final ranking.  ``frontier_logits
-    (flat_buf [B*K, total], t) -> [B*K, V]`` supplies each step's
-    next-token logits; ``write_at`` is the buffer index of the first
-    generated slot (seq2seq: 1 past BOS; LM: the prompt length).
-    ``buf`` is ``[B, K, total]`` with the prompt/BOS prefix in place.
-    Returns ``(tokens [B, total], scores [B])`` — best beam per row."""
+    """Shared re-decode beam machinery (:func:`beam_search`,
+    :func:`beam_search_seq2seq`): drives :func:`_beam_expand` with each
+    step's full-forward frontier logits.  ``frontier_logits (flat_buf
+    [B*K, total], t) -> [B*K, V]`` supplies each step's next-token
+    logits; ``write_at`` is the buffer index of the first generated slot
+    (seq2seq: 1 past BOS; LM: the prompt length).  ``buf`` is ``[B, K,
+    total]`` with the prompt/BOS prefix in place.  Returns ``(tokens
+    [B, total], scores [B])`` — best beam per row."""
     B, K, total = buf.shape
     # all beams start identical: beam 0 live at 0.0, the rest at -inf so
     # the first expansion seeds K DISTINCT continuations
@@ -1016,35 +1513,17 @@ def _beam_loop(frontier_logits, buf, write_at, V, max_new_tokens,
     def step(carry, t):
         buf, scores, finished, lengths = carry
         logits_t = frontier_logits(buf.reshape(B * K, total), t)
-        logp = jax.nn.log_softmax(
-            logits_t.astype(jnp.float32), axis=-1
-        ).reshape(B, K, V)
-        # finished beams: only the pad continuation, at unchanged score
-        frozen = jnp.full((V,), -jnp.inf).at[pad_id].set(0.0)
-        logp = jnp.where(finished[:, :, None], frozen[None, None], logp)
-        cand = scores[:, :, None] + logp  # [B, K, V]
-        top_scores, top_idx = jax.lax.top_k(cand.reshape(B, K * V), K)
-        src_beam = top_idx // V  # which beam each winner extends
-        token = (top_idx % V).astype(jnp.int32)
-        buf = jnp.take_along_axis(buf, src_beam[:, :, None], axis=1)
-        finished = jnp.take_along_axis(finished, src_beam, axis=1)
-        lengths = jnp.take_along_axis(lengths, src_beam, axis=1)
-        buf = jax.lax.dynamic_update_slice_in_dim(
-            buf, token[:, :, None], write_at + t, axis=2
+        buf, scores, finished, lengths, _ = _beam_expand(
+            logits_t, buf, scores, finished, lengths, write_at + t,
+            eos_id, pad_id,
         )
-        lengths = jnp.where(finished, lengths, lengths + 1)
-        finished = finished | (token == eos_id)
-        return (buf, top_scores, finished, lengths), None
+        return (buf, scores, finished, lengths), None
 
     (buf, scores, finished, lengths), _ = jax.lax.scan(
         step, (buf, scores, finished, lengths),
         jnp.arange(max_new_tokens),
     )
-    norm = ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** length_penalty
-    final = scores / norm
-    best = jnp.argmax(final, axis=1)
-    tokens = jnp.take_along_axis(buf, best[:, None, None], axis=1)[:, 0]
-    return tokens, jnp.take_along_axis(final, best[:, None], axis=1)[:, 0]
+    return _beam_finalize(buf, scores, lengths, length_penalty)
 
 
 def _seq2seq_prepare(model, params, inputs, inputs_mask, max_new_tokens):
@@ -1154,7 +1633,7 @@ def beam_search_seq2seq(
     its length-normalized log-probability.
     """
     B = inputs.shape[0]
-    K, V = beam_size, model.config.vocab_size
+    K = beam_size
     variables, memory, total = _seq2seq_prepare(
         model, params, inputs, inputs_mask, max_new_tokens
     )
@@ -1176,5 +1655,5 @@ def beam_search_seq2seq(
         )
         return jax.lax.dynamic_slice_in_dim(logits, t, 1, axis=1)[:, 0]
 
-    return _beam_loop(frontier_logits, buf, 1, V, max_new_tokens,
+    return _beam_loop(frontier_logits, buf, 1, max_new_tokens,
                       eos_id, pad_id, length_penalty)

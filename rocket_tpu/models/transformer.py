@@ -611,10 +611,16 @@ class PipelinedBlocks(nn.Module):
         # keeps ITS positions as it flows stage to stage.
         has_seg = segment_ids is not None
 
+        # the layer module is created HERE, outside the traced schedule:
+        # flax refuses Module construction across a jax transform
+        # boundary (lax.scan / shard_map trace levels differ), while a
+        # detached module's pure .apply is fine anywhere
+        blk = Block(cfg, parent=None)
+
         def one_layer(layer_params, xtree):
             h, pos = xtree[0], xtree[1]
             seg = xtree[2] if has_seg else None
-            out, _ = Block(cfg).apply(
+            out, _ = blk.apply(
                 {"params": layer_params}, h, pos, seg, train
             )
             return (out, pos) + ((seg,) if has_seg else ())

@@ -59,10 +59,14 @@ def linear_cross_entropy(
     N, H = x.shape
     pad = (-N) % chunk_size
     if pad:
-        x = jnp.concatenate([x, jnp.zeros((pad, H), x.dtype)], axis=0)
-        targets = jnp.concatenate(
-            [targets, jnp.zeros((pad,), targets.dtype)], axis=0
-        )
+        # Pad by scattering into a zeros buffer, NOT by concatenating a
+        # zeros block: GSPMD mis-partitions concat(row-sharded x,
+        # replicated pad) when the table is tensor-sharded — the chunk
+        # loop's logsumexp partial sums get all-reduced twice and every
+        # nll comes back scaled by the tensor-axis size (or NaN).  The
+        # dynamic-update-slice form keeps the row sharding intact.
+        x = jnp.zeros((N + pad, H), x.dtype).at[:N].set(x)
+        targets = jnp.zeros((N + pad,), targets.dtype).at[:N].set(targets)
     xs = x.reshape(-1, chunk_size, H)
     ts = targets.reshape(-1, chunk_size)
 

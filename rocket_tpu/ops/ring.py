@@ -31,6 +31,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from rocket_tpu.parallel.collectives import shard_map
 from rocket_tpu.parallel.mesh import DATA_AXES
 
 MASK_VALUE = -0.7 * float(jnp.finfo(jnp.float32).max)
@@ -102,7 +103,7 @@ def ring_attention(
     in_specs = (spec, spec, spec) + ((seg_spec,) if has_seg else ())
 
     @functools.partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=spec,

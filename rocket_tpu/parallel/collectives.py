@@ -39,7 +39,26 @@ import jax
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec
 
-shard_map = jax.shard_map
+def _resolve_shard_map():
+    """``jax.shard_map`` moved: new jax exports it at the top level (with
+    a ``check_vma`` kwarg); 0.4.x only has
+    ``jax.experimental.shard_map.shard_map`` (spelled ``check_rep``).
+    Resolve whichever exists and normalize the kwarg so every call site
+    in the repo can use the one modern spelling."""
+    native = getattr(jax, "shard_map", None)
+    if native is not None:
+        return native
+    from jax.experimental.shard_map import shard_map as legacy
+
+    @functools.wraps(legacy)
+    def compat(f, *, mesh, in_specs, out_specs, check_vma=True, **kw):
+        return legacy(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=check_vma, **kw)
+
+    return compat
+
+
+shard_map = _resolve_shard_map()
 
 AxisName = Union[str, Tuple[str, ...]]
 
@@ -98,7 +117,7 @@ def on_mesh(
     (ring attention, pipeline schedules)."""
     if fn is None:
         return functools.partial(on_mesh, mesh, in_specs, out_specs, check_vma=check_vma)
-    return jax.shard_map(
+    return shard_map(
         fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs, check_vma=check_vma
     )
 

@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from rocket_tpu.parallel.collectives import shard_map
+
 Carry = Any
 
 
@@ -211,7 +213,7 @@ def gpipe(
             for buf in ys
         )
 
-    ys_out = jax.shard_map(
+    ys_out = shard_map(
         stage_program,
         mesh=mesh,
         in_specs=(param_spec, xs_full_spec, const_spec),

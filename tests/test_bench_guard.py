@@ -86,6 +86,35 @@ def test_resolve_scan_guard_noop_without_scan(bench):
     assert out is t and note is None and not calls
 
 
+def test_tune_matches_headline_canonicalization(bench):
+    from rocket_tpu.ops.flash import auto_blocks
+
+    # an old record with explicit blocks and missing later-added knobs
+    # (attention/window/mu_dtype) still describes today's headline config
+    bq, bk = auto_blocks(bench.GPT2_TUNE["seq"])
+    explicit = dict(bench.GPT2_TUNE, block_q=bq, block_k=bk)
+    for k in ("attention", "window", "mu_dtype"):
+        explicit.pop(k)
+    assert bench._tune_matches_headline(explicit)
+    assert bench._tune_matches_headline(dict(bench.GPT2_TUNE))
+    # any real divergence — or an unknown knob — is a different config
+    assert not bench._tune_matches_headline(dict(bench.GPT2_TUNE, batch=8))
+    assert not bench._tune_matches_headline(dict(bench.GPT2_TUNE, bogus=1))
+    assert not bench._tune_matches_headline(None)
+
+
+def test_last_good_ladder_reports_current_gpt2_tune(bench):
+    """VERDICT r5 #5: the ladder's gpt2 entry must be a measurement of
+    the CURRENT ``GPT2_TUNE`` (the promoted bs16 sweep winner), not the
+    superseded bs8 plain record."""
+    gpt2 = bench._last_good_ladder().get("gpt2")
+    assert gpt2 is not None and gpt2.get("value")
+    assert bench._tune_matches_headline(gpt2.get("tune")), gpt2.get("tune")
+    assert gpt2["tune"]["batch"] == bench.GPT2_TUNE["batch"]
+    # the promoted record must not still look like sweep output
+    assert "sweep_point" not in gpt2
+
+
 def test_bench_emits_stale_ladder_when_backend_unreachable(tmp_path):
     """The driver contract for tunnel-down rounds (VERDICT r4 next #7b):
     a plain `python bench.py` whose backend probes all fail must exit 0
@@ -115,3 +144,10 @@ def test_bench_emits_stale_ladder_when_backend_unreachable(tmp_path):
     assert all(r.get("stale") is True and r.get("value") for r in recs)
     assert recs[-1]["config"] == "gpt2"  # headline record stays last
     assert "measured_age_s" in recs[-1]
+    # the re-emitted gpt2 record must describe the CURRENT headline
+    # config (VERDICT r5 #5: it used to replay the superseded bs8 tune)
+    import bench as bench_mod
+
+    assert bench_mod._tune_matches_headline(recs[-1].get("tune")), \
+        recs[-1].get("tune")
+    assert recs[-1]["tune"]["batch"] == bench_mod.GPT2_TUNE["batch"]
