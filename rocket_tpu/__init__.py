@@ -29,6 +29,7 @@ from rocket_tpu.data import (
     IterableSource,
     TokenFileSource,
 )
+from rocket_tpu.engine.sentinel import DivergenceSentinel
 from rocket_tpu.launch import Launcher, Looper, notebook_launch
 from rocket_tpu.observe import (
     Accuracy,
@@ -55,6 +56,7 @@ __all__ = [
     "DataLoader",
     "Dataset",
     "Dispatcher",
+    "DivergenceSentinel",
     "Events",
     "ConcatSource",
     "GeneratorSource",

@@ -114,6 +114,7 @@ class Module(Dispatcher):
         donate: bool = True,
         eval_with_ema: bool = False,
         fuse_accumulation: bool = False,
+        skip_nonfinite: Optional[bool] = None,
         logger: Optional[Any] = None,
     ) -> None:
         super().__init__(
@@ -124,6 +125,12 @@ class Module(Dispatcher):
         self._donate = donate
         self._eval_with_ema = eval_with_ema
         self._fuse_accum = fuse_accumulation
+        # None = defer to runtime.skip_nonfinite_updates (set by a sibling
+        # DivergenceSentinel(policy='skip')) at step-build time.  Pass True
+        # explicitly when the steps build at setup (input_spec given) and
+        # the sentinel mounts at a lower priority.
+        self._skip_nonfinite = skip_nonfinite
+        self._lr_scale: Optional[float] = None
         self._built = False
         self._state: Optional[TrainState] = None
         self._steps: Optional[dict] = None
@@ -408,8 +415,18 @@ class Module(Dispatcher):
         return self._fuse_accum and self._accum > 1
 
     def _build_steps(self, policy) -> None:
+        skip = (
+            self._skip_nonfinite
+            if self._skip_nonfinite is not None
+            else bool(getattr(self._runtime, "skip_nonfinite_updates", False))
+        )
         if self._tx is not None:
             if self._use_window:
+                if skip:
+                    self._logger.warning(
+                        "skip_nonfinite guard is not supported with "
+                        "fuse_accumulation — fused window steps run unguarded"
+                    )
                 self._steps = {
                     "window": build_window_step(
                         self._adapter.apply_fn,
@@ -428,6 +445,7 @@ class Module(Dispatcher):
                     policy=policy,
                     gradient_accumulation_steps=self._accum,
                     donate=self._donate,
+                    skip_nonfinite=skip,
                 )
         self._eval_step = build_eval_step(
             self._adapter.apply_fn, self._objectives, policy=policy,
@@ -519,7 +537,15 @@ class Module(Dispatcher):
             else:
                 synced = (self._micro_idx + 1) % self._accum == 0
                 step = self._steps["sync" if synced else "micro"]
-                self._state, logs = step(self._state, batch)
+                if self._lr_scale is None:
+                    self._state, logs = step(self._state, batch)
+                else:
+                    # Cooldown scale rides in as a device scalar operand —
+                    # changing its VALUE re-uses the compiled step; only the
+                    # None↔scalar signature change traces once.
+                    self._state, logs = step(
+                        self._state, batch, jnp.float32(self._lr_scale)
+                    )
                 self._micro_idx = 0 if synced else self._micro_idx + 1
                 logs = Attributes(logs)
                 logs.synced = synced
@@ -534,6 +560,39 @@ class Module(Dispatcher):
         # Children (Loss/Optimizer/Scheduler) do host-side logging only.
         for capsule in self._capsules:
             capsule.launch(attrs)
+
+    # -- resilience hooks (DivergenceSentinel) -------------------------------
+
+    def set_lr_scale(self, value: Optional[float]) -> None:
+        """Scale every optimizer update by ``value`` until reset with
+        ``None`` — the sentinel's post-rollback LR cooldown.  Ignored by
+        fused-window steps (which take no scale operand)."""
+        self._lr_scale = None if value is None else float(value)
+
+    def restore_from(self, path: Any) -> None:
+        """Replace the live TrainState with the snapshot at ``path``
+        (restored sharded, direct to mesh layout) — the sentinel's
+        rollback-to-last-good hook."""
+        if self._state is None:
+            raise RuntimeError(
+                "Module.restore_from before materialization — nothing to "
+                "shape the restore target from"
+            )
+        from rocket_tpu.persist.orbax_io import default_io
+
+        target = jax.tree_util.tree_map(
+            lambda leaf, s: jax.ShapeDtypeStruct(
+                leaf.shape, leaf.dtype, sharding=s
+            ),
+            self._state,
+            self._shardings,
+        )
+        restored = default_io().restore_item(
+            str(path), self._ckpt_key, target={"state": target}
+        )
+        self._state = restored["state"]
+        self._sync_micro_idx()
+        self._logger.info("rolled back module state to %s", path)
 
     # -- state --------------------------------------------------------------
 

@@ -143,4 +143,14 @@ class Dataset(Capsule):
     def load_state_dict(self, state: Attributes) -> None:
         if not state:
             return
-        self._batch_idx = int(state["batch_idx"])
+        # Schema-tolerant: warn-and-default on a missing key instead of
+        # KeyError-ing the resume (ISSUE 2 satellite).
+        value = state.get("batch_idx")
+        if value is None:
+            self._logger.warning(
+                "checkpoint has no 'batch_idx' (older schema?) — restarting "
+                "the epoch from batch 0"
+            )
+            self._batch_idx = 0
+            return
+        self._batch_idx = int(value)

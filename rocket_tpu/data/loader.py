@@ -41,6 +41,7 @@ import numpy as np
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.utils.placement import collate as default_collate
+from rocket_tpu.utils.retry import retry_call
 
 # Fork-inherited worker state (NOT passed through initargs: pickling a
 # large in-memory source per worker would copy it through a pipe; fork
@@ -80,7 +81,11 @@ def _worker_batch(args: tuple) -> Any:
     (a backend init in a forked child could grab the parent's TPU)."""
     idx_local, valid_local = args
     state = _WORKER_ENTRY
-    samples = [state["source"][int(i)] for i in idx_local]
+    # Transient I/O (NFS hiccup, GCS 5xx surfacing as OSError) retries with
+    # backoff instead of killing the run (utils.retry).
+    samples = [
+        retry_call(state["source"].__getitem__, int(i)) for i in idx_local
+    ]
     return _wrap_batch(
         state["collate"](samples), valid_local, state["mask_key"]
     )
@@ -224,7 +229,11 @@ class DataLoader:
         p = jax.process_index()
         lo = p * self.local_batch_size
         hi = lo + self.local_batch_size
-        samples = [self.source[int(i)] for i in idx[lo:hi]]
+        # Transient I/O retries with backoff (utils.retry) — a single NFS
+        # hiccup must not kill an hours-long run.
+        samples = [
+            retry_call(self.source.__getitem__, int(i)) for i in idx[lo:hi]
+        ]
         return self._collate_local(samples, valid[lo:hi])
 
     def _to_device(self, host_batch: Any) -> Any:

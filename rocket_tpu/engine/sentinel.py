@@ -1,0 +1,294 @@
+"""DivergenceSentinel — watch the loss for nonfinite values and spikes.
+
+Training runs die two ways: loudly (preemption — handled by the
+Checkpointer's SIGTERM path) and quietly (a NaN batch poisons the Adam
+moments at iteration 40k and every later snapshot is garbage).  This
+capsule handles the quiet way with three escalating policies:
+
+- ``policy='warn'``: log a rate-limited warning and count events; the run
+  continues.  The zero-risk observability baseline.
+- ``policy='skip'``: arm the **in-graph** guard — at setup it sets
+  ``runtime.skip_nonfinite_updates`` so the Module compiles its train step
+  with ``engine.step``'s ``lax.cond`` gate: the optimizer update applies
+  only when loss and grad-norm are finite.  The detection predicate lives
+  on device, so the happy path costs one scalar ``isfinite`` + select and
+  **no extra host sync and no extra traced step body**.  This capsule then
+  only observes (warns when skips happen).
+- ``policy='rollback'``: on nonfinite loss or a ``spike_factor``× jump over
+  the running EMA (for ``patience`` consecutive checks), restore the
+  newest *valid* snapshot of the current run (``persist.integrity.
+  latest_valid``) into the sibling Module and continue at
+  ``cooldown_factor`` LR for ``cooldown_steps`` iterations.  After
+  ``max_rollbacks`` the sentinel votes a run-level stop instead of
+  thrashing.
+
+Host-side detection is **one iteration delayed by design**: each launch
+stages the current loss with ``copy_to_host_async`` and inspects the value
+staged the *previous* iteration — by then the transfer has landed, so the
+read never stalls the async dispatch queue (the same discipline as the
+Tracker/Meter capsules).
+
+Mount it in the train looper between the Module and the Checkpointer
+(default priority 500).  With ``policy='skip'`` and a Module that
+materializes eagerly (``input_spec`` given), the Module builds its steps at
+setup *before* this capsule's setup can arm the flag — pass
+``Module(skip_nonfinite=True)`` explicitly in that layout.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+
+POLICIES = ("warn", "skip", "rollback")
+
+
+class DivergenceSentinel(Capsule):
+    """Parameters
+    ----------
+    policy:
+        ``'warn'`` | ``'skip'`` | ``'rollback'`` (see module docstring).
+    metric:
+        Key inspected in ``attrs.step_logs`` (default ``'loss'``).
+    check_every:
+        Inspect every Nth training iteration (device→host transfer cost is
+        tiny, but 1 is only the right default for small steps).
+    spike_factor:
+        A finite loss counts as divergent when it exceeds the running EMA
+        by ``spike_factor * max(|EMA|, 1e-8)``.  ``None`` disables spike
+        detection (nonfinite-only).
+    ema_decay / warmup:
+        EMA smoothing and the number of observations before spike detection
+        arms (early-training loss is legitimately wild).
+    patience:
+        Consecutive divergent checks required before acting (1 = act on
+        first).  Nonfinite values always count; a single finite
+        non-divergent check resets the streak.
+    module:
+        The Module to roll back (``policy='rollback'``).  ``None`` =
+        auto-discover the single Module in the runtime's checkpoint
+        registry at first use.
+    cooldown_factor / cooldown_steps:
+        Post-rollback LR scale and how many iterations it holds.
+    max_rollbacks:
+        Budget; exceeding it requests a run-level stop.
+    """
+
+    def __init__(
+        self,
+        policy: str = "warn",
+        metric: str = "loss",
+        check_every: int = 1,
+        spike_factor: Optional[float] = 10.0,
+        ema_decay: float = 0.98,
+        warmup: int = 20,
+        patience: int = 1,
+        module: Optional[Any] = None,
+        cooldown_factor: float = 0.1,
+        cooldown_steps: int = 100,
+        max_rollbacks: int = 3,
+        statefull: bool = False,
+        priority: int = 500,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(statefull=statefull, priority=priority, logger=logger)
+        if policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, got {policy!r}")
+        if check_every < 1:
+            raise ValueError("check_every must be >= 1")
+        if patience < 1:
+            raise ValueError("patience must be >= 1")
+        self._policy = policy
+        self._metric = metric
+        self._check_every = int(check_every)
+        self._spike_factor = spike_factor
+        self._ema_decay = float(ema_decay)
+        self._warmup = int(warmup)
+        self._patience = int(patience)
+        self._module = module
+        self._cooldown_factor = float(cooldown_factor)
+        self._cooldown_steps = int(cooldown_steps)
+        self._max_rollbacks = int(max_rollbacks)
+        # host-side detector state (intentionally NOT checkpointed: a
+        # restored run re-warms its EMA, which is safer than trusting a
+        # pre-divergence statistic)
+        self._seen = 0
+        self._ema: Optional[float] = None
+        self._staged: Optional[Any] = None
+        self._streak = 0
+        self._cooldown_until: Optional[int] = None
+        self.events = 0  # divergences observed (tests / user introspection)
+        self.rollbacks = 0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def setup(self, attrs: Optional[Attributes] = None) -> None:
+        super().setup(attrs)
+        if self._policy == "skip":
+            # Module reads this when building its jitted steps — the guard
+            # compiles INTO the step (engine.step skip_nonfinite).
+            self._runtime.skip_nonfinite_updates = True
+        if self._policy == "rollback" and self._runtime.project_dir is None:
+            raise RuntimeError(
+                "DivergenceSentinel(policy='rollback') needs snapshots to "
+                "roll back to — give the Launcher a tag and mount a "
+                "Checkpointer"
+            )
+
+    def reset(self, attrs: Optional[Attributes] = None) -> None:
+        # Cycle boundary: drop the staged device scalar (its buffer may be
+        # donated away between cycles) but keep the EMA across epochs.
+        self._staged = None
+        self._streak = 0
+
+    # -- iteration -----------------------------------------------------------
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        if attrs is None or attrs.step_logs is None:
+            return
+        looper = attrs.looper
+        if looper is not None and not looper.grad_enabled:
+            return  # eval cycles: nothing to guard
+        self._seen += 1
+        if self._cooldown_until is not None and self._seen >= self._cooldown_until:
+            self._cooldown_until = None
+            module = self._find_module()
+            if module is not None:
+                module.set_lr_scale(None)
+                self._logger.info("LR cooldown over — full learning rate")
+        if self._seen % self._check_every != 0:
+            return
+        value = self._stage_and_read(attrs.step_logs.get(self._metric))
+        if value is None:
+            return
+        if self._is_divergent(value):
+            self._streak += 1
+            if self._streak >= self._patience:
+                self._streak = 0
+                self._act(value)
+        else:
+            self._streak = 0
+            self._update_ema(value)
+
+    def _stage_and_read(self, current: Any) -> Optional[float]:
+        """Stage this iteration's device scalar, return LAST iteration's as
+        a host float — the transfer overlaps one full step, so the read is
+        free by the time we make it."""
+        staged, self._staged = self._staged, current
+        if current is not None:
+            start = getattr(current, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:
+                    pass  # already on host (numpy / python scalar)
+        if staged is None:
+            return None
+        try:
+            return float(staged)
+        except (TypeError, ValueError):
+            return None
+
+    # -- detection -----------------------------------------------------------
+
+    def _is_divergent(self, value: float) -> bool:
+        if not math.isfinite(value):
+            return True
+        if (
+            self._spike_factor is not None
+            and self._ema is not None
+            and self._seen > self._warmup
+        ):
+            return value - self._ema > self._spike_factor * max(
+                abs(self._ema), 1e-8
+            )
+        return False
+
+    def _update_ema(self, value: float) -> None:
+        if self._ema is None:
+            self._ema = value
+        else:
+            d = self._ema_decay
+            self._ema = d * self._ema + (1.0 - d) * value
+
+    # -- policies ------------------------------------------------------------
+
+    def _act(self, value: float) -> None:
+        self.events += 1
+        if self._policy in ("warn", "skip"):
+            # Under 'skip' the in-graph guard already protected the state;
+            # this is the host-side observation of the same event.
+            if self.events <= 10 or self.events % 100 == 0:
+                self._logger.warning(
+                    "divergent %s=%s at observation %d (event #%d%s)",
+                    self._metric, value, self._seen, self.events,
+                    ", update skipped in-graph" if self._policy == "skip"
+                    else "",
+                )
+            return
+        self._rollback(value)
+
+    def _rollback(self, value: float) -> None:
+        from rocket_tpu.persist import integrity
+        from rocket_tpu.persist.orbax_io import default_io
+
+        if self.rollbacks >= self._max_rollbacks:
+            self._runtime.request_stop(
+                f"divergence persists after {self.rollbacks} rollbacks"
+            )
+            self._logger.error(
+                "divergent %s=%s and rollback budget exhausted — stopping",
+                self._metric, value,
+            )
+            return
+        default_io().wait()  # in-flight save must land before we scan
+        path = integrity.latest_valid(
+            self._runtime.project_dir,
+            do_quarantine=self._runtime.is_main_process,
+        )
+        if path is None:
+            self._runtime.request_stop("diverged with no valid snapshot")
+            self._logger.error(
+                "divergent %s=%s but no valid snapshot to roll back to — "
+                "stopping", self._metric, value,
+            )
+            return
+        module = self._find_module()
+        if module is None:
+            self._runtime.request_stop("diverged; no Module to roll back")
+            self._logger.error("no Module found in checkpoint registry")
+            return
+        self._logger.warning(
+            "divergent %s=%s — rolling back to %s, LR x%g for %d iters",
+            self._metric, value, path, self._cooldown_factor,
+            self._cooldown_steps,
+        )
+        module.restore_from(path)
+        module.set_lr_scale(self._cooldown_factor)
+        self._cooldown_until = self._seen + self._cooldown_steps
+        self.rollbacks += 1
+        # The post-rollback regime is new — re-warm the detector.
+        self._ema = None
+        self._staged = None
+        self._streak = 0
+
+    def _find_module(self) -> Optional[Any]:
+        if self._module is not None:
+            return self._module
+        from rocket_tpu.core.module import Module
+
+        modules = [
+            c for c in self._runtime.checkpointables if isinstance(c, Module)
+        ]
+        if len(modules) == 1:
+            self._module = modules[0]
+            return self._module
+        if not modules:
+            return None
+        raise RuntimeError(
+            "multiple Modules in the checkpoint registry — pass module= to "
+            "DivergenceSentinel"
+        )

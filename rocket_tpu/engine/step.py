@@ -119,6 +119,7 @@ def build_train_step(
     gradient_accumulation_steps: int = 1,
     log_grad_norm: bool = True,
     donate: bool = True,
+    skip_nonfinite: bool = False,
 ) -> Dict[str, Callable[[TrainState, Any], Tuple[TrainState, Dict[str, Any]]]]:
     """Build the jitted training step(s).
 
@@ -126,6 +127,22 @@ def build_train_step(
     ``{"sync": fn, "micro": fn}`` — the host calls ``micro`` for the first
     ``n-1`` batches of each window and ``sync`` on the boundary (reference
     ``sync_gradients`` cadence, ``loss.py:101``/``optimizer.py:133``).
+
+    ``skip_nonfinite=True`` compiles the divergence guard INTO the step: a
+    ``lax.cond`` applies the optimizer update (and adopts the new mutable
+    collections) only when the loss and the gradient norm are finite, so
+    one NaN batch cannot poison params or Adam moments.  The predicate
+    lives on device — no host sync, no extra trace: the guard is part of
+    the single compiled step body, and the happy path costs one scalar
+    ``isfinite`` + select.  Skipped sync steps leave ``step``/params/
+    opt_state untouched, still reset the accumulation window, and report
+    ``logs['skipped'] = 1.0``.
+
+    Every step additionally accepts a trailing ``lr_scale`` operand (device
+    scalar); ``None`` (the default call signature) compiles without it.
+    The DivergenceSentinel's rollback policy passes a cooldown factor
+    through it — a changed VALUE is just a new input, only the None→scalar
+    transition re-traces once.
     """
     if gradient_accumulation_steps < 1:
         raise ValueError("gradient_accumulation_steps must be >= 1")
@@ -140,11 +157,28 @@ def build_train_step(
         (loss, (logs, new_mutable, _)), grads = grad_fn(
             state.params, state.mutable, rng, batch
         )
-        return grads, new_mutable, logs
+        return loss, grads, new_mutable, logs
 
-    def micro_step(state: TrainState, batch: Any):
-        grads, new_mutable, logs = forward_backward(state, batch)
-        accum = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
+    def micro_step(state: TrainState, batch: Any, lr_scale=None):
+        loss, grads, new_mutable, logs = forward_backward(state, batch)
+        if skip_nonfinite:
+            finite = jnp.isfinite(loss) & jnp.isfinite(optax.global_norm(grads))
+            # A nonfinite micro-batch contributes ZERO gradient to the
+            # window (cond keeps the running sum) but still advances the
+            # micro counter so the host's sync cadence stays aligned.
+            accum = jax.lax.cond(
+                finite,
+                lambda: jax.tree_util.tree_map(
+                    jnp.add, state.grad_accum, grads
+                ),
+                lambda: state.grad_accum,
+            )
+            new_mutable = jax.lax.cond(
+                finite, lambda: new_mutable, lambda: state.mutable
+            )
+            logs["skipped"] = 1.0 - finite.astype(jnp.float32)
+        else:
+            accum = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
         new_state = state.replace(
             grad_accum=accum,
             mutable=new_mutable,
@@ -152,23 +186,53 @@ def build_train_step(
         )
         return new_state, logs
 
-    def sync_step(state: TrainState, batch: Any):
-        grads, new_mutable, logs = forward_backward(state, batch)
+    def sync_step(state: TrainState, batch: Any, lr_scale=None):
+        loss, grads, new_mutable, logs = forward_backward(state, batch)
         if n > 1:
             grads = jax.tree_util.tree_map(
                 lambda a, g: (a + g) / n, state.grad_accum, grads
             )
+        if log_grad_norm or skip_nonfinite:
+            grad_norm = optax.global_norm(grads)
         if log_grad_norm:
-            logs["grad_norm"] = optax.global_norm(grads)
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
+            logs["grad_norm"] = grad_norm
+
+        def apply_update(grads):
+            updates, new_opt_state = tx.update(
+                grads, state.opt_state, state.params
+            )
+            if lr_scale is not None:
+                updates = jax.tree_util.tree_map(
+                    lambda u: u * lr_scale, updates
+                )
+            new_params = optax.apply_updates(state.params, updates)
+            return new_params, new_opt_state, state.step + 1, new_mutable
+
+        if skip_nonfinite:
+            finite = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+            new_params, new_opt_state, new_step, kept_mutable = jax.lax.cond(
+                finite,
+                apply_update,
+                lambda grads: (
+                    state.params, state.opt_state, state.step, state.mutable
+                ),
+                grads,
+            )
+            logs["skipped"] = 1.0 - finite.astype(jnp.float32)
+        else:
+            new_params, new_opt_state, new_step, kept_mutable = apply_update(
+                grads
+            )
         replacements = dict(
-            step=state.step + 1,
+            step=new_step,
             params=new_params,
             opt_state=new_opt_state,
-            mutable=new_mutable,
+            mutable=kept_mutable,
         )
         if n > 1:
+            # The window resets in BOTH cond branches — a skipped boundary
+            # discards the whole window, keeping device micro/accum aligned
+            # with the host's cadence counter.
             replacements["grad_accum"] = jax.tree_util.tree_map(
                 jnp.zeros_like, state.grad_accum
             )

@@ -127,14 +127,63 @@ class Launcher(Dispatcher):
         runtime.project_dir = self._resolve_project_dir()
         if runtime.project_dir is not None:
             runtime.logging_dir = os.path.join(runtime.project_dir, "logs")
+        # A re-launch (same process, possibly same external runtime) starts
+        # with a clean stop vote — stop_training is per-run, not per-process.
+        runtime.stop_training = False
+        runtime.stop_reason = None
         self.bind(runtime)
         self._create_project_dir(runtime)
         if self._resume_path is not None:
-            runtime.resume_spec = Attributes(
-                path=self._resume_path,
-                load_capsules=self._resume_load_capsules,
-            )
+            resolved = self._resolve_resume_path(runtime)
+            if resolved is not None:
+                runtime.resume_spec = Attributes(
+                    path=resolved,
+                    load_capsules=self._resume_load_capsules,
+                )
         super().setup(attrs)
+
+    def _resolve_resume_path(self, runtime: Runtime) -> Optional[str]:
+        """Turn the armed resume request into a VERIFIED snapshot path.
+
+        ``"auto"`` scans the tag's versioned project dirs for the newest
+        snapshot that passes :func:`~rocket_tpu.persist.integrity.verify`
+        (none found = fresh start, the restart-the-same-command contract).
+        An explicit path is verified too; a broken one is quarantined and
+        the newest valid sibling takes over — restore falls back instead of
+        crashing on a half-written snapshot.  Host 0 decides (it owns the
+        quarantine renames); everyone adopts its answer.
+        """
+        from rocket_tpu.persist import integrity
+
+        path = self._resume_path
+        resolved: Optional[str] = None
+        failed = False
+        if runtime.is_main_process:
+            if path == "auto":
+                if self._tag is None:
+                    raise RuntimeError(
+                        "resume('auto') needs a project dir — give the "
+                        "Launcher a tag"
+                    )
+                base = os.path.join(self._project_root, self._tag)
+                resolved = integrity.latest_valid(base)
+                if resolved is None:
+                    self._logger.info(
+                        "resume('auto'): no valid snapshot under %s — "
+                        "starting fresh", base,
+                    )
+            else:
+                resolved = integrity.resolve_restore_path(path)
+                failed = resolved is None
+        resolved, failed = multihost.broadcast_object((resolved, failed))
+        if failed:
+            raise RuntimeError(
+                f"resume: no valid snapshot at {path} and no verified "
+                f"fallback beside it (quarantined dirs are *.corrupt)"
+            )
+        if resolved is not None and resolved != path:
+            self._logger.warning("resume: restoring from %s", resolved)
+        return resolved
 
     def destroy(self, attrs: Optional[Attributes] = None) -> None:
         super().destroy(attrs)
@@ -160,10 +209,15 @@ class Launcher(Dispatcher):
         mesh."""
         if self._resume_path is None:
             return
+        spec = getattr(self._runtime, "resume_spec", None)
+        if spec is None:
+            return  # resume('auto') with nothing on disk — fresh start
         from rocket_tpu.persist.orbax_io import default_io
 
         io = default_io()
-        path = self._resume_path
+        # The VERIFIED path from _resolve_resume_path — not the raw request
+        # ('auto', or a corrupt dir that fell back to a sibling).
+        path = str(spec.path)
         available = set(io.keys(path))
         if not self._resume_load_capsules:
             # Weights-only: leave resume_spec armed for Modules, skip the
@@ -259,14 +313,32 @@ class Launcher(Dispatcher):
         self.setup(attrs)
         try:
             self._resume(attrs)
+            stopped = False
             for epoch in range(self._epoch_idx, self._num_epochs):
+                # Run-level stop vote (preemption snapshot written, sentinel
+                # abort): honored BETWEEN cycles too, where no attrs.looper
+                # exists to carry a terminate vote — without this check a
+                # SIGTERM landing between cycles would start the next epoch
+                # and blow the grace window (ISSUE 2 satellite).
+                if self._runtime.stop_training:
+                    stopped = True
+                    break
                 self._epoch_idx = epoch
                 attrs.launcher.epoch_idx = epoch
                 for capsule in self._capsules:
                     capsule.set(attrs)
                     capsule.launch(attrs)
                     capsule.reset(attrs)
-            self._epoch_idx = self._num_epochs
+                    if self._runtime.stop_training:
+                        break  # skip sibling cycles; exit within the grace window
+            if self._runtime.stop_training:
+                stopped = True
+                self._logger.warning(
+                    "run stopped early at epoch %d: %s",
+                    self._epoch_idx, self._runtime.stop_reason or "stop vote",
+                )
+            if not stopped:
+                self._epoch_idx = self._num_epochs
         finally:
             del attrs.launcher
             self.destroy(attrs)
@@ -288,5 +360,21 @@ class Launcher(Dispatcher):
     def load_state_dict(self, state: Attributes) -> None:
         if not state:
             return
-        self._epoch_idx = int(state["epoch_idx"])
-        self._saved_num_procs = int(state["num_procs"])
+        # Schema-tolerant: a checkpoint from an older schema warns and
+        # defaults instead of KeyError-ing the whole resume (ISSUE 2
+        # satellite).  num_procs=None simply skips the topology guard.
+        epoch = state.get("epoch_idx")
+        if epoch is None:
+            self._logger.warning(
+                "checkpoint has no 'epoch_idx' (older schema?) — resuming "
+                "at epoch 0"
+            )
+            epoch = 0
+        self._epoch_idx = int(epoch)
+        procs = state.get("num_procs")
+        if procs is None:
+            self._logger.warning(
+                "checkpoint has no 'num_procs' — skipping the resume "
+                "topology guard"
+            )
+        self._saved_num_procs = int(procs) if procs is not None else None

@@ -153,10 +153,19 @@ class Looper(Dispatcher):
             # Dataset's termination vote when the stream exhausts.
             while looper.repeats is None or self._iter_idx < looper.repeats:
                 attrs.batch = None
+                # Cleared WITH the batch: an iteration where no step runs
+                # (dataset exhausted on a resumed epoch) must not re-expose
+                # the previous iteration's logs to observers downstream
+                # (trackers, sentinels) as if a step had happened.
+                attrs.step_logs = None
                 for capsule in self._capsules:
                     capsule.launch(attrs)
                 self._iter_idx += 1
-                if looper.terminate:
+                if looper.terminate or (
+                    self._runtime is not None and self._runtime.stop_training
+                ):
+                    # cycle vote OR run-level stop (preemption/divergence
+                    # abort cast by a capsule outside this cycle's protocol)
                     break
                 if bar is not None:
                     bar.update(1)
@@ -167,6 +176,7 @@ class Looper(Dispatcher):
                 bar.set_postfix(self._format_state(looper.state))
                 bar.close()
         attrs.batch = None
+        attrs.step_logs = None
 
     # -- progress ------------------------------------------------------------
 
@@ -206,4 +216,13 @@ class Looper(Dispatcher):
     def load_state_dict(self, state: Attributes) -> None:
         if not state:
             return
-        self._iter_idx = int(state["iter_idx"])
+        # Schema-tolerant: warn-and-default on keys an older checkpoint
+        # lacks instead of KeyError-ing the resume (ISSUE 2 satellite).
+        value = state.get("iter_idx")
+        if value is None:
+            self._logger.warning(
+                "checkpoint has no 'iter_idx' (older schema?) — keeping %d",
+                self._iter_idx,
+            )
+            return
+        self._iter_idx = int(value)

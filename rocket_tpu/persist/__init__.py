@@ -1,4 +1,22 @@
 from rocket_tpu.persist.checkpoint import Checkpointer
+from rocket_tpu.persist.integrity import (
+    build_manifest,
+    latest_valid,
+    quarantine,
+    read_manifest,
+    resolve_restore_path,
+    verify,
+)
 from rocket_tpu.persist.orbax_io import CheckpointIO, default_io
 
-__all__ = ["Checkpointer", "CheckpointIO", "default_io"]
+__all__ = [
+    "Checkpointer",
+    "CheckpointIO",
+    "default_io",
+    "build_manifest",
+    "latest_valid",
+    "quarantine",
+    "read_manifest",
+    "resolve_restore_path",
+    "verify",
+]

@@ -29,6 +29,7 @@ from typing import Any, Optional
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.persist import integrity
 from rocket_tpu.persist.orbax_io import default_io
 
 # Set by the SIGTERM handler; checked at every iteration boundary.  TPU pod
@@ -94,6 +95,7 @@ class Checkpointer(Capsule):
         self._best: list = []  # (value, path), best first
         self._installed_handler = False
         self._iter_idx = 0
+        self._epoch_idx: Optional[int] = None
         self._saved_dirs: list = []
 
     # -- lifecycle -----------------------------------------------------------
@@ -192,6 +194,9 @@ class Checkpointer(Capsule):
     # -- cycle ---------------------------------------------------------------
 
     def launch(self, attrs: Optional[Attributes] = None) -> None:
+        if attrs is not None and attrs.launcher is not None:
+            # Stashed for the snapshot manifest (save() has no attrs).
+            self._epoch_idx = int(attrs.launcher.epoch_idx or 0)
         if _preempted.is_set():
             # Preemption (SIGTERM): snapshot NOW, make it durable, and vote
             # to terminate the loop so the process exits inside the grace
@@ -205,6 +210,12 @@ class Checkpointer(Capsule):
             self._iter_idx += 1
             if attrs is not None and attrs.looper is not None:
                 attrs.looper.terminate = True
+            # The looper vote alone is lost when this capsule runs OUTSIDE a
+            # looper cycle (attrs.looper is None) — and even inside one it
+            # only ends the CYCLE: the Launcher would start the next epoch.
+            # The runtime-level stop flag is what the epoch loop checks.
+            if self._runtime is not None:
+                self._runtime.request_stop("preemption checkpoint written")
             return
         # (idx + 1) cadence: first save after save_every iterations, not a
         # useless step-0 snapshot (reference checkpoint.py:116-120 semantics).
@@ -258,7 +269,10 @@ class Checkpointer(Capsule):
         if not items:
             self._logger.warning("nothing to checkpoint — no stateful state yet")
             return path
-        default_io().save(path, items, force=True)
+        manifest = integrity.build_manifest(
+            items, iter_idx=self._iter_idx, epoch_idx=self._epoch_idx,
+        )
+        default_io().save(path, items, force=True, manifest=manifest)
         self._logger.info("checkpoint -> %s", path)
         # Retention across restarts comes from the setup() disk scan, not
         # from persisting this list.
@@ -332,14 +346,22 @@ class Checkpointer(Capsule):
     def _prune(self) -> None:
         if self._keep_last is None or len(self._saved_dirs) <= self._keep_last:
             return
-        if self._runtime is not None and not self._runtime.is_main_process:
-            # host 0 owns retention; others just forget the path
-            self._saved_dirs = self._saved_dirs[-self._keep_last :]
-            return
         default_io().wait()  # never delete around an in-flight save
+        if self._runtime is not None:
+            # Prune/restore race (ISSUE 2 satellite): host 0 must not rmtree
+            # while a peer is still mid-restore from the victim dir.  Every
+            # host reaches this point with the same _saved_dirs (save cadence
+            # is identical), so the barrier pairs up; host 0 deletes only
+            # after the barrier proves everyone is past any restore.
+            self._runtime.wait_for_everyone("ckpt-prune")
+        main = self._runtime is None or self._runtime.is_main_process
         while len(self._saved_dirs) > self._keep_last:
             victim = self._saved_dirs.pop(0)
-            shutil.rmtree(victim, ignore_errors=True)
+            if main:
+                shutil.rmtree(victim, ignore_errors=True)
+        if self._runtime is not None:
+            # Peers must not start a NEW restore from a dir being deleted.
+            self._runtime.wait_for_everyone("ckpt-pruned")
 
     # -- state ---------------------------------------------------------------
 
@@ -351,4 +373,13 @@ class Checkpointer(Capsule):
     def load_state_dict(self, state: Attributes) -> None:
         if not state:
             return
-        self._iter_idx = int(state["iter_idx"])
+        # Schema-tolerant (ISSUE 2 satellite): an older checkpoint missing a
+        # key warns and keeps the default instead of KeyError-ing the resume.
+        value = state.get("iter_idx")
+        if value is None:
+            self._logger.warning(
+                "checkpoint has no 'iter_idx' (older schema?) — keeping %d",
+                self._iter_idx,
+            )
+            return
+        self._iter_idx = int(value)

@@ -1,0 +1,365 @@
+"""Checkpoint integrity — manifests, commit markers, verification, fallback.
+
+The failure mode this module exists for: a TPU pod is preempted (or a host
+OOMs) *while* an async Orbax save is draining to disk.  The snapshot
+directory exists, some item subdirectories exist, and a blind
+``restore(path)`` either crashes mid-run or — worse — silently loads a
+half-written tree.  Orbax-style distributed checkpointing (PAPERS.md)
+treats durability as a two-phase protocol; this module adds that protocol
+on top of :class:`~rocket_tpu.persist.orbax_io.CheckpointIO`:
+
+1. **Manifest** (``manifest.json``): written next to the items — schema
+   version, iteration/epoch counters, process count, and per-item tree
+   structure (leaf path, shape, dtype, crc32 of the host bytes where the
+   leaf is addressable).  The manifest describes what a *complete* snapshot
+   must contain.
+2. **Commit marker** (``_COMMITTED``): an empty file written by host 0 only
+   after ``CheckpointIO.wait()`` confirms every host's shards are durable.
+   Its absence is the unambiguous sign of an interrupted save.
+3. :func:`verify` checks marker + manifest + item presence (``deep=True``
+   additionally restores and re-checksums every leaf).
+4. :func:`latest_valid` scans newest-to-oldest and returns the first
+   snapshot that verifies, quarantining broken ones by renaming to
+   ``<name>.corrupt`` so retention globs and future scans skip them.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from rocket_tpu.utils.logging import get_logger
+
+_logger = get_logger("integrity")
+
+SCHEMA_VERSION = 1
+MANIFEST_NAME = "manifest.json"
+COMMIT_MARKER = "_COMMITTED"
+CORRUPT_SUFFIX = ".corrupt"
+
+
+# -- manifest construction ---------------------------------------------------
+
+
+def _canon_path(path: Any) -> str:
+    """Container-agnostic leaf path: a live TrainState addresses leaves by
+    attribute (``.state.opt_state[0].count``) while its orbax round-trip is
+    nested dicts (``['state']['opt_state'][0]['count']``) — ``keystr`` of the
+    two never matches.  Canonicalize to the bare key names."""
+    parts = []
+    for key in path:
+        for attr in ("name", "key", "idx"):
+            value = getattr(key, attr, None)
+            if value is not None:
+                parts.append(str(value))
+                break
+        else:
+            parts.append(str(key))
+    return "/".join(parts)
+
+
+def _leaf_record(path: Any, leaf: Any) -> Dict[str, Any]:
+    record: Dict[str, Any] = {"path": _canon_path(path)}
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        shape = np.shape(leaf)
+    record["shape"] = [int(s) for s in shape]
+    record["dtype"] = str(np.asarray(leaf).dtype if not hasattr(leaf, "dtype")
+                          else leaf.dtype)
+    record["crc32"] = _leaf_crc32(leaf)
+    return record
+
+
+def _leaf_crc32(leaf: Any) -> Optional[int]:
+    """crc32 of the leaf's host bytes; ``None`` when the leaf is a
+    multi-host-sharded array this process cannot address in full (the
+    structural fields still verify it)."""
+    try:
+        if hasattr(leaf, "is_fully_addressable") and not leaf.is_fully_addressable:
+            return None
+        host = np.asarray(jax.device_get(leaf))
+    except Exception:  # never let integrity metadata break a save
+        return None
+    return int(zlib.crc32(np.ascontiguousarray(host).tobytes()))
+
+
+def build_manifest(
+    items: Dict[str, Any],
+    *,
+    iter_idx: Optional[int] = None,
+    epoch_idx: Optional[int] = None,
+    checksums: bool = True,
+) -> Dict[str, Any]:
+    """Manifest dict for a composite snapshot about to be saved.
+
+    ``checksums=False`` skips the per-leaf crc32 (and its device sync) for
+    latency-critical saves; structure is always recorded.
+    """
+    manifest: Dict[str, Any] = {
+        "schema": SCHEMA_VERSION,
+        "iter_idx": iter_idx,
+        "epoch_idx": epoch_idx,
+        "num_procs": jax.process_count(),
+        "items": {},
+    }
+    for key, tree in items.items():
+        leaves = jax.tree_util.tree_leaves_with_path(tree)
+        if checksums:
+            structure = [_leaf_record(p, leaf) for p, leaf in leaves]
+        else:
+            structure = [
+                {**_leaf_record(p, leaf), "crc32": None} for p, leaf in leaves
+            ]
+        manifest["items"][key] = {"structure": structure}
+    return manifest
+
+
+def write_manifest(path: str, manifest: Dict[str, Any]) -> None:
+    with open(os.path.join(path, MANIFEST_NAME), "w") as fh:
+        json.dump(manifest, fh)
+
+
+def write_commit_marker(path: str) -> None:
+    marker = os.path.join(path, COMMIT_MARKER)
+    with open(marker, "w") as fh:
+        fh.write("")
+    # The marker is the durability witness — fsync it so a host crash right
+    # after the write cannot leave a marker that predates its own snapshot.
+    fd = os.open(marker, os.O_RDONLY)
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def read_manifest(path: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(os.path.join(path, MANIFEST_NAME)) as fh:
+            return json.load(fh)
+    except (OSError, ValueError):
+        return None
+
+
+def is_committed(path: str) -> bool:
+    return os.path.isfile(os.path.join(path, COMMIT_MARKER))
+
+
+# -- verification ------------------------------------------------------------
+
+
+def verify(path: str, deep: bool = False) -> Tuple[bool, str]:
+    """``(ok, reason)`` for a snapshot directory.
+
+    Shallow (default): commit marker present, manifest parses at a known
+    schema, every manifest item has its directory on disk.  ``deep=True``
+    additionally restores each item as host numpy and re-computes every
+    recorded crc32 — expensive (full read), meant for offline audits and
+    the chaos tests, not the restore hot path.
+    """
+    path = os.path.abspath(path)
+    if not os.path.isdir(path):
+        return False, "missing: no such directory"
+    if not is_committed(path):
+        return False, "uncommitted: no commit marker (interrupted save?)"
+    manifest = read_manifest(path)
+    if manifest is None:
+        return False, "corrupt: manifest missing or unparseable"
+    schema = manifest.get("schema")
+    if not isinstance(schema, int) or schema < 1 or schema > SCHEMA_VERSION:
+        return False, f"corrupt: unsupported manifest schema {schema!r}"
+    items = manifest.get("items")
+    if not isinstance(items, dict) or not items:
+        return False, "corrupt: manifest lists no items"
+    for key in items:
+        if not os.path.isdir(os.path.join(path, key)):
+            return False, f"corrupt: item {key!r} directory missing"
+    if not deep:
+        return True, "ok"
+    return _verify_deep(path, items)
+
+
+def _verify_deep(path: str, items: Dict[str, Any]) -> Tuple[bool, str]:
+    from rocket_tpu.persist.orbax_io import default_io
+
+    io = default_io()
+    for key, meta in items.items():
+        try:
+            tree = io.restore_item(path, key)
+        except Exception as exc:
+            return False, f"corrupt: item {key!r} fails to restore ({exc})"
+        leaves = jax.tree_util.tree_leaves_with_path(tree)
+        recorded = {
+            rec["path"]: rec for rec in meta.get("structure", [])
+        }
+        if len(leaves) != len(recorded):
+            return (
+                False,
+                f"corrupt: item {key!r} has {len(leaves)} leaves, manifest "
+                f"records {len(recorded)}",
+            )
+        for p, leaf in leaves:
+            rec = recorded.get(_canon_path(p))
+            if rec is None:
+                return (
+                    False,
+                    f"corrupt: item {key!r} leaf "
+                    f"{_canon_path(p)} not in manifest",
+                )
+            if list(np.shape(leaf)) != list(rec["shape"]):
+                return (
+                    False,
+                    f"corrupt: item {key!r} leaf {rec['path']} shape "
+                    f"{list(np.shape(leaf))} != recorded {rec['shape']}",
+                )
+            if rec.get("crc32") is not None:
+                actual = _leaf_crc32(leaf)
+                if actual is not None and actual != rec["crc32"]:
+                    return (
+                        False,
+                        f"corrupt: item {key!r} leaf {rec['path']} checksum "
+                        f"mismatch",
+                    )
+    return True, "ok"
+
+
+# -- quarantine + fallback ---------------------------------------------------
+
+
+def quarantine(path: str, reason: str = "") -> Optional[str]:
+    """Rename a broken snapshot to ``<name>.corrupt`` (``.corrupt.N`` when a
+    prior quarantine of the same name exists).  Returns the new path, or
+    ``None`` when the rename itself fails (e.g. raced by another host —
+    harmless, the dir no longer verifies either way)."""
+    path = os.path.abspath(path)
+    target = path + CORRUPT_SUFFIX
+    n = 0
+    while os.path.exists(target):
+        n += 1
+        target = f"{path}{CORRUPT_SUFFIX}.{n}"
+    try:
+        os.rename(path, target)
+    except OSError:
+        return None
+    _logger.warning("quarantined snapshot %s -> %s (%s)", path, target, reason)
+    return target
+
+
+_SNAPSHOT_DIR = re.compile(r"\d+$")
+
+
+def _snapshot_dirs(root: str, subdir: str) -> List[Tuple[int, str]]:
+    """``(index, path)`` for digit-named snapshot dirs under
+    ``root/subdir`` (the Checkpointer's ``weights/{:06d}`` layout), newest
+    first."""
+    found = []
+    for dirpath in glob.glob(os.path.join(root, subdir, "*")):
+        name = os.path.basename(dirpath)
+        if _SNAPSHOT_DIR.fullmatch(name) and os.path.isdir(dirpath):
+            found.append((int(name), dirpath))
+    found.sort(reverse=True)
+    return found
+
+
+def latest_valid(
+    root: str,
+    subdirs: Tuple[str, ...] = ("weights",),
+    deep: bool = False,
+    do_quarantine: bool = True,
+) -> Optional[str]:
+    """Newest snapshot under ``root`` that verifies, scanning the versioned
+    project layout (``root/v0,v1,…/<subdir>/<iter>`` — or ``root`` itself
+    when it has no ``v*`` children).  Broken candidates newer than the
+    first valid one are quarantined (main-process duty; pass
+    ``do_quarantine=False`` on other hosts and adopt host 0's answer via
+    a broadcast)."""
+    root = os.path.abspath(root)
+    versions = []
+    if os.path.isdir(root):
+        for name in os.listdir(root):
+            if name.startswith("v") and name[1:].isdigit():
+                versions.append((int(name[1:]), os.path.join(root, name)))
+    versions.sort(reverse=True)
+    roots = [p for _, p in versions] or [root]
+    candidates: List[Tuple[Tuple[int, int], str]] = []
+    for vi, vroot in enumerate(roots):
+        for subdir in subdirs:
+            for idx, path in _snapshot_dirs(vroot, subdir):
+                # newest version first, then newest iteration
+                candidates.append(((-vi, idx), path))
+    candidates.sort(reverse=True)
+    for _, path in candidates:
+        ok, reason = verify(path, deep=deep)
+        if ok:
+            return path
+        if do_quarantine:
+            quarantine(path, reason)
+        else:
+            _logger.warning("skipping invalid snapshot %s (%s)", path, reason)
+    return None
+
+
+def resolve_restore_path(
+    path: str, deep: bool = False, do_quarantine: bool = True
+) -> Optional[str]:
+    """Verify an explicit restore path; on failure quarantine it and fall
+    back to the newest valid sibling snapshot (same parent directory, lower
+    iteration index).  Returns ``None`` when nothing verifies.
+
+    Legacy snapshots (no manifest AND no marker — written before integrity
+    landed) are trusted with a warning: an explicit resume from an old run
+    must keep working.
+    """
+    path = os.path.abspath(path)
+    ok, reason = verify(path, deep=deep)
+    if ok:
+        return path
+    if (
+        os.path.isdir(path)
+        and read_manifest(path) is None
+        and not is_committed(path)
+        and _has_items(path)
+    ):
+        _logger.warning(
+            "snapshot %s predates integrity manifests — restoring unverified",
+            path,
+        )
+        return path
+    _logger.warning("restore path %s failed verification (%s)", path, reason)
+    parent = os.path.dirname(path)
+    name = os.path.basename(path)
+    if do_quarantine:
+        quarantine(path, reason)
+    fallbacks = [
+        (idx, p)
+        for idx, p in _snapshot_dirs(os.path.dirname(parent),
+                                     os.path.basename(parent))
+        if os.path.basename(p) != name
+    ]
+    for _, candidate in sorted(fallbacks, reverse=True):
+        ok, why = verify(candidate, deep=deep)
+        if ok:
+            _logger.warning("falling back to previous snapshot %s", candidate)
+            return candidate
+        if do_quarantine:
+            quarantine(candidate, why)
+    return None
+
+
+def _has_items(path: str) -> bool:
+    """A directory that at least LOOKS like an orbax composite (one
+    non-hidden subdir) — the legacy-trust gate."""
+    try:
+        return any(
+            os.path.isdir(os.path.join(path, n))
+            for n in os.listdir(path)
+            if not n.startswith(("_", "."))
+        )
+    except OSError:
+        return False

@@ -25,6 +25,9 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from rocket_tpu.persist import integrity
+from rocket_tpu.utils.retry import retry_call
+
 # ``partial_restore`` landed in newer Orbax; 0.7.x spells the same thing
 # as ``transforms={}`` (item keys absent from the target are dropped,
 # present ones restore from the saved original).
@@ -51,6 +54,11 @@ class CheckpointIO:
     def __init__(self, use_async: bool = True) -> None:
         self._use_async = use_async
         self._checkpointer: Optional[ocp.AsyncCheckpointer] = None
+        # Two-phase commit: paths (+ their manifests) whose async save has
+        # been ISSUED but not yet confirmed durable.  ``wait()`` drains the
+        # write and only then finalizes — manifest + commit marker — so an
+        # interrupted save can never look complete (integrity.verify).
+        self._pending_commits: List[tuple] = []
 
     def _ckptr(self):
         if self._checkpointer is None:
@@ -64,12 +72,24 @@ class CheckpointIO:
     # -- save ---------------------------------------------------------------
 
     def save(
-        self, path: str, items: Dict[str, Any], *, force: bool = True, wait: bool = False
+        self,
+        path: str,
+        items: Dict[str, Any],
+        *,
+        force: bool = True,
+        wait: bool = False,
+        manifest: Optional[Dict[str, Any]] = None,
     ) -> None:
         """Write a composite snapshot. Async by default: returns once device
         buffers are copied out; the write itself overlaps the next steps
         (reference blocks the loop in ``accelerator.save_state``,
-        ``checkpoint.py:129``)."""
+        ``checkpoint.py:129``).
+
+        ``manifest`` (from :func:`~rocket_tpu.persist.integrity.
+        build_manifest`) arms the two-phase commit: the manifest + commit
+        marker land only at the next :meth:`wait`, once every host's shards
+        are durable.  Without it the snapshot is legacy-style (unverified).
+        """
         path = os.path.abspath(path)
         args = ocp.args.Composite(
             **{
@@ -77,15 +97,35 @@ class CheckpointIO:
                 for key, tree in items.items()
             }
         )
-        self._ckptr().save(path, args=args, force=force)
+        retry_call(self._ckptr().save, path, args=args, force=force, tries=3)
+        if manifest is not None:
+            self._pending_commits.append((path, manifest))
         if wait:
             self.wait()
 
     def wait(self) -> None:
-        """Block until any in-flight async save is durable."""
+        """Block until any in-flight async save is durable, then finalize
+        pending commits (manifest + marker — host 0 writes, every host
+        forgets its pending list)."""
         ckptr = self._checkpointer
         if ckptr is not None and hasattr(ckptr, "wait_until_finished"):
             ckptr.wait_until_finished()
+        pending, self._pending_commits = self._pending_commits, []
+        if not pending:
+            return
+        if jax.process_index() == 0:
+            for path, manifest in pending:
+                try:
+                    integrity.write_manifest(path, manifest)
+                    integrity.write_commit_marker(path)
+                except OSError as exc:
+                    # An uncommittable snapshot stays uncommitted — restore
+                    # will skip it; do not kill the training loop over it.
+                    import logging
+
+                    logging.getLogger("rocket_tpu.CheckpointIO").warning(
+                        "could not finalize snapshot %s: %s", path, exc
+                    )
 
     # -- restore ------------------------------------------------------------
 
@@ -150,8 +190,15 @@ class CheckpointIO:
         # binds each item key to the first args type it sees, which would
         # conflict between StandardSave (writes) and PyTreeRestore (partial
         # reads) on the same key.
-        with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
-            result = ckptr.restore(path, args=ocp.args.Composite(**composite_args))
+        def _restore():
+            with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+                return ckptr.restore(
+                    path, args=ocp.args.Composite(**composite_args)
+                )
+
+        # Restores hit the same flaky host filesystems as saves (GCS/NFS
+        # reads at resume time) — jittered backoff before giving up.
+        result = retry_call(_restore, tries=3)
         return {key: result[key] for key in want}
 
     def restore_item(

@@ -74,6 +74,14 @@ class Runtime:
         self._trackers: Dict[str, Any] = {}
         self.project_dir: Optional[str] = None
         self.logging_dir: Optional[str] = None
+        # Run-level stop vote (preemption, divergence abort): the Launcher's
+        # epoch loop checks it between cycles, so a vote cast where no
+        # ``attrs.looper`` exists still stops the run (ISSUE 2 satellite).
+        self.stop_training = False
+        self.stop_reason: Optional[str] = None
+        # Set by DivergenceSentinel(policy="skip") at setup; Module reads it
+        # when building the jitted steps (engine.step skip_nonfinite guard).
+        self.skip_nonfinite_updates = False
         # Pending resume request (set by Launcher.resume): Attributes with
         # ``path`` and ``load_capsules``.  Capsules with lazily-materialized
         # array state (Module) consume it at materialization time; host-scalar
@@ -113,6 +121,12 @@ class Runtime:
 
     def wait_for_everyone(self, tag: str = "barrier") -> None:
         multihost.sync_global_devices(tag)
+
+    def request_stop(self, reason: str = "") -> None:
+        """Vote to end the run at the next epoch boundary (preemption,
+        divergence abort).  Sticky for the rest of the launch."""
+        self.stop_training = True
+        self.stop_reason = reason or self.stop_reason
 
     # -- shardings ----------------------------------------------------------
 

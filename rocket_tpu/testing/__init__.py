@@ -1,0 +1,15 @@
+"""Fault-injection helpers for resilience testing (see testing.chaos)."""
+
+from rocket_tpu.testing.chaos import (
+    FaultySource,
+    NaNInjector,
+    SigtermInjector,
+    corrupt_snapshot,
+)
+
+__all__ = [
+    "FaultySource",
+    "NaNInjector",
+    "SigtermInjector",
+    "corrupt_snapshot",
+]

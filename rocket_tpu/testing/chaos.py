@@ -1,0 +1,198 @@
+"""Chaos harness — deterministic fault injection for resilience tests.
+
+Three fault families, matching how TPU training actually dies:
+
+- **host I/O flakes**: :class:`FaultySource` wraps a map-style Source and
+  raises on scheduled fetches — transiently (the retry path must absorb
+  it) or persistently (the failure must surface, not hang);
+- **torn / corrupted snapshots**: :func:`corrupt_snapshot` breaks a saved
+  checkpoint directory the three ways a preempted save tears one
+  (interrupted before commit, item directory lost, bytes garbled on disk);
+- **preemption**: :class:`SigtermInjector` raises SIGTERM at iteration k —
+  the in-process equivalent of the TPU maintenance event the
+  Checkpointer's grace-window path exists for;
+- **numerical poison**: :class:`NaNInjector` overwrites the batch with
+  NaNs at iteration k, driving the DivergenceSentinel / skip-step guard.
+
+Everything here is deterministic (iteration- or call-indexed, never
+random) so chaos tests replay exactly.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Any, Iterable, Optional
+
+import numpy as np
+
+from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.persist import integrity
+
+
+class FaultySource:
+    """Wrap a map-style Source; fetches listed in ``fail_on`` raise.
+
+    ``fail_on`` indexes the *successful-fetch sequence* (0 = the first
+    sample ever produced), not the sample index — a prefetching loader
+    reorders sample indexes, but the fetch position is stable, and a
+    retried attempt re-hits the SAME position (so a persistent fault stays
+    persistent under :func:`~rocket_tpu.utils.retry.retry_call`).  Each
+    scheduled position fails ``times`` times before succeeding (transient
+    fault); ``times=None`` fails forever (persistent fault).
+    """
+
+    def __init__(
+        self,
+        source: Any,
+        fail_on: Iterable[int] = (0,),
+        times: Optional[int] = 1,
+        exc_type: type = OSError,
+        message: str = "injected transient I/O fault",
+    ) -> None:
+        self._source = source
+        self._fail_on = set(int(i) for i in fail_on)
+        self._times = times
+        self._exc_type = exc_type
+        self._message = message
+        self.calls = 0  # __getitem__ invocations, including failed ones
+        self.faults = 0  # exceptions actually raised
+        self._pos = 0  # successful fetches so far
+        self._remaining: dict = {}
+
+    def __len__(self) -> int:
+        return len(self._source)
+
+    def __getitem__(self, index: int) -> Any:
+        pos = self._pos
+        self.calls += 1
+        if pos in self._fail_on:
+            left = self._remaining.get(pos, self._times)
+            if left is None or left > 0:
+                if left is not None:
+                    self._remaining[pos] = left - 1
+                self.faults += 1
+                raise self._exc_type(f"{self._message} (fetch #{pos})")
+        value = self._source[index]
+        self._pos += 1
+        return value
+
+
+def corrupt_snapshot(path: str, mode: str = "uncommit") -> None:
+    """Break a saved snapshot directory in place.
+
+    - ``'uncommit'``: delete the commit marker — the torn-save signature
+      (shallow :func:`~rocket_tpu.persist.integrity.verify` fails);
+    - ``'drop_item'``: remove one manifest-listed item directory (shallow
+      verify fails: structure incomplete);
+    - ``'garble'``: flip bytes in the middle of the largest data file while
+      keeping marker + manifest intact — only ``verify(deep=True)``'s
+      checksum pass can catch this one.
+    """
+    path = os.path.abspath(path)
+    if mode == "uncommit":
+        marker = os.path.join(path, integrity.COMMIT_MARKER)
+        if os.path.isfile(marker):
+            os.remove(marker)
+        return
+    if mode == "drop_item":
+        import shutil
+
+        manifest = integrity.read_manifest(path)
+        items = sorted((manifest or {}).get("items", {}))
+        if not items:
+            raise ValueError(f"{path}: no manifest items to drop")
+        shutil.rmtree(os.path.join(path, items[0]))
+        return
+    if mode == "garble":
+        victim, size = None, -1
+        for dirpath, _, filenames in os.walk(path):
+            for name in filenames:
+                if name in (integrity.MANIFEST_NAME, integrity.COMMIT_MARKER):
+                    continue
+                full = os.path.join(dirpath, name)
+                n = os.path.getsize(full)
+                if n > size:
+                    victim, size = full, n
+        if victim is None:
+            raise ValueError(f"{path}: no data files to garble")
+        with open(victim, "r+b") as fh:
+            fh.seek(size // 2)
+            chunk = fh.read(min(64, max(1, size - size // 2)))
+            fh.seek(size // 2)
+            fh.write(bytes(b ^ 0xFF for b in chunk))
+        return
+    raise ValueError(
+        f"mode must be 'uncommit' | 'drop_item' | 'garble', got {mode!r}"
+    )
+
+
+class SigtermInjector(Capsule):
+    """Raise SIGTERM in-process at training iteration ``at_iter``
+    (0-indexed, counted across cycles) — the deterministic stand-in for a
+    TPU preemption notice.  Mount it ABOVE the Checkpointer (priority >
+    100) so the signal is delivered before the Checkpointer's launch of the
+    same iteration observes the flag."""
+
+    def __init__(
+        self,
+        at_iter: int,
+        once: bool = True,
+        priority: int = 150,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(statefull=False, priority=priority, logger=logger)
+        self._at_iter = int(at_iter)
+        self._once = once
+        self._iter = 0
+        self.fired = 0
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        fire = self._iter == self._at_iter and not (self._once and self.fired)
+        self._iter += 1
+        if fire:
+            self.fired += 1
+            self._logger.warning(
+                "injecting SIGTERM at iteration %d", self._iter - 1
+            )
+            signal.raise_signal(signal.SIGTERM)
+
+
+class NaNInjector(Capsule):
+    """Overwrite every float leaf of ``attrs.batch`` with NaN on the listed
+    training iterations (0-indexed, counted across cycles).  Mount it
+    between the Dataset and the Module IN LIST ORDER (it shares their
+    default priority 1000; the Dispatcher's sort is stable) so the poisoned
+    batch is what the train step consumes."""
+
+    def __init__(
+        self,
+        at_iters: Iterable[int] = (0,),
+        priority: int = 1000,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(statefull=False, priority=priority, logger=logger)
+        self._at_iters = set(int(i) for i in at_iters)
+        self._iter = 0
+        self.injected = 0
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        it = self._iter
+        self._iter += 1
+        if attrs is None or attrs.batch is None or it not in self._at_iters:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        def poison(leaf: Any) -> Any:
+            dtype = np.result_type(leaf)
+            if not np.issubdtype(dtype, np.floating):
+                return leaf
+            if isinstance(leaf, jax.Array):
+                return jnp.full_like(leaf, jnp.nan)
+            return np.full_like(np.asarray(leaf), np.nan)
+
+        attrs.batch = jax.tree_util.tree_map(poison, attrs.batch)
+        self.injected += 1
+        self._logger.warning("injected NaN batch at iteration %d", it)

@@ -1,0 +1,111 @@
+"""Jittered exponential backoff for flaky host-side edges.
+
+TPU pods fail at the *host* boundary far more often than in XLA: GCS reads
+time out, NFS mounts flap, a preempted peer holds a file lock for a few
+seconds.  Those edges (``Source.__getitem__``, orbax save/restore) are
+wrapped in :func:`retry_call` — bounded, jittered exponential backoff with a
+wall-clock budget, so one transient fault costs milliseconds instead of the
+whole run, while a *persistent* fault still surfaces as the original
+exception (robustness must not become silence).
+
+Design notes:
+
+- jitter is full-range (``uniform(0, delay)``): on a pod, hundreds of hosts
+  hit the same flaky filesystem at the same step, and synchronized retries
+  re-create the stampede that caused the timeout;
+- the ``budget`` caps total *sleep* time, independent of ``tries`` — a slow
+  edge with a generous ``tries`` must not stall the preemption grace window;
+- only exception types in ``retry_on`` are retried; everything else (a
+  genuine bug, a KeyboardInterrupt) propagates immediately.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import time
+from typing import Any, Callable, Optional, Tuple, Type
+
+from rocket_tpu.utils.logging import get_logger
+
+_logger = get_logger("retry")
+
+# OSError covers IOError, TimeoutError, ConnectionError — the host-IO family.
+DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (OSError,)
+
+
+def retry_call(
+    fn: Callable[..., Any],
+    *args: Any,
+    tries: int = 3,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    budget: Optional[float] = 30.0,
+    retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON,
+    logger: Any = None,
+    **kwargs: Any,
+) -> Any:
+    """Call ``fn(*args, **kwargs)``, retrying ``retry_on`` failures.
+
+    Up to ``tries`` attempts; sleep before attempt ``k`` is drawn from
+    ``uniform(0, min(max_delay, base_delay * 2**(k-1)))``.  ``budget``
+    bounds the total slept time in seconds (``None`` = unbounded); when the
+    budget is exhausted the last exception is raised even if attempts
+    remain.
+    """
+    if tries < 1:
+        raise ValueError("tries must be >= 1")
+    log = logger or _logger
+    slept = 0.0
+    for attempt in range(tries):
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as exc:
+            if attempt + 1 >= tries:
+                raise
+            delay = random.uniform(
+                0.0, min(max_delay, base_delay * (2.0 ** attempt))
+            )
+            if budget is not None and slept + delay > budget:
+                log.warning(
+                    "retry budget (%.1fs) exhausted after %d attempt(s): %s",
+                    budget, attempt + 1, exc,
+                )
+                raise
+            log.warning(
+                "transient failure (attempt %d/%d, retrying in %.3fs): %s",
+                attempt + 1, tries, delay, exc,
+            )
+            time.sleep(delay)
+            slept += delay
+    raise AssertionError("unreachable")  # pragma: no cover
+
+
+def retrying(
+    tries: int = 3,
+    base_delay: float = 0.05,
+    max_delay: float = 2.0,
+    budget: Optional[float] = 30.0,
+    retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON,
+    logger: Any = None,
+) -> Callable[[Callable[..., Any]], Callable[..., Any]]:
+    """Decorator form of :func:`retry_call`."""
+
+    def wrap(fn: Callable[..., Any]) -> Callable[..., Any]:
+        @functools.wraps(fn)
+        def wrapped(*args: Any, **kwargs: Any) -> Any:
+            return retry_call(
+                fn,
+                *args,
+                tries=tries,
+                base_delay=base_delay,
+                max_delay=max_delay,
+                budget=budget,
+                retry_on=retry_on,
+                logger=logger,
+                **kwargs,
+            )
+
+        return wrapped
+
+    return wrap

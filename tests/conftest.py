@@ -29,6 +29,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running tests (multi-process rendezvous)"
     )
+    config.addinivalue_line(
+        "markers",
+        "resilience: fault-tolerance / chaos tests (see docs/reliability.md; "
+        "long sweeps run with -m 'slow and resilience')",
+    )
 
 
 @pytest.fixture(scope="session")

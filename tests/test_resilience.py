@@ -1,0 +1,650 @@
+"""Resilience tests — chaos-driven proof of the fault-tolerance subsystem.
+
+Covers the PR-2 acceptance criteria:
+
+- SIGTERM at iteration k, rerun with ``resume("auto")`` → loss trajectory
+  matches the uninterrupted run;
+- corrupting the newest snapshot makes restore fall back to the previous
+  valid one (and quarantine the broken dir as ``*.corrupt``);
+- an injected NaN batch is skipped (optimizer state untouched) and
+  training proceeds with finite loss;
+- transient Source faults are absorbed by the retry path; persistent ones
+  still surface;
+- the skip-step guard adds ZERO extra traced step bodies on the happy path
+  (bench-guard, instrumentation style of test_decode_hotpath.py).
+
+Run the long chaos sweeps with ``pytest -m "slow and resilience"``.
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+import rocket_tpu as rt
+from rocket_tpu.models.objectives import cross_entropy
+from rocket_tpu.persist import integrity
+from rocket_tpu.testing import (
+    FaultySource,
+    NaNInjector,
+    SigtermInjector,
+    corrupt_snapshot,
+)
+
+from test_pipeline import MLP, synthetic_classification
+
+pytestmark = pytest.mark.resilience
+
+
+class LossRecorder(rt.Capsule):
+    """Host-side per-iteration loss trace (sync read — test-only)."""
+
+    def __init__(self):
+        super().__init__(statefull=False, priority=400)
+        self.losses = []
+
+    def launch(self, attrs=None):
+        if attrs is None or attrs.step_logs is None:
+            return
+        looper = attrs.looper
+        if looper is not None and not looper.grad_enabled:
+            return
+        loss = attrs.step_logs.get("loss")
+        if loss is not None:
+            self.losses.append(float(loss))
+
+
+def _tree(tmp_path, data, *, tag, epochs, pre_model=(), extra=(),
+          save_every=100, resume=None, seed=0):
+    """Standard chaos tree: 256 samples / batch 64 = 4 iterations per epoch.
+
+    ``pre_model`` capsules mount between the Dataset and the Module (same
+    priority 1000, stable sort keeps list order) — where a NaNInjector must
+    sit to poison the batch the train step consumes.  ``extra`` capsules
+    mount after the Module (sentinels, voters, SIGTERM injectors).
+    """
+    model = rt.Module(
+        MLP(),
+        capsules=[
+            rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+            rt.Optimizer(learning_rate=2e-2),
+        ],
+    )
+    recorder = LossRecorder()
+    looper = rt.Looper(
+        capsules=[
+            rt.Dataset(rt.ArraySource(data), batch_size=64, shuffle=True,
+                       seed=7),
+            *pre_model,
+            model,
+            *extra,
+            recorder,
+            rt.Checkpointer(save_every=save_every),
+        ],
+        progress=False,
+    )
+    launcher = rt.Launcher(
+        capsules=[looper], tag=tag, num_epochs=epochs,
+        project_root=str(tmp_path), seed=seed,
+    )
+    if resume is not None:
+        launcher.resume(resume)
+    return launcher, model, recorder
+
+
+# -- checkpoint integrity ----------------------------------------------------
+
+
+def test_manifest_and_commit_marker(tmp_path, devices):
+    """Every Checkpointer snapshot carries a manifest and, once the async
+    save drains, a commit marker; verify() accepts it."""
+    data = synthetic_classification(n=256)
+    launcher, _, _ = _tree(tmp_path, data, tag="mani", epochs=1, save_every=2)
+    launcher.launch()  # destroy() waits -> commits finalized
+    snaps = sorted((tmp_path / "mani" / "v0" / "weights").iterdir())
+    assert [s.name for s in snaps] == ["000001", "000003"]
+    for snap in snaps:
+        assert (snap / integrity.MANIFEST_NAME).is_file()
+        assert (snap / integrity.COMMIT_MARKER).is_file()
+        ok, reason = integrity.verify(str(snap))
+        assert ok, reason
+        manifest = integrity.read_manifest(str(snap))
+        assert manifest["schema"] == integrity.SCHEMA_VERSION
+        assert manifest["iter_idx"] == int(snap.name)
+        # at least the module item, with per-leaf structure + checksums
+        assert any(k.startswith("module") for k in manifest["items"])
+        for item in manifest["items"].values():
+            assert item["structure"], "empty leaf structure"
+            assert all("crc32" in rec for rec in item["structure"])
+
+
+def test_corrupt_newest_falls_back_and_quarantines(tmp_path, devices):
+    """Acceptance: corrupting the newest snapshot makes restore fall back to
+    the previous valid one; the broken dir is renamed ``*.corrupt``."""
+    data = synthetic_classification(n=256)
+    launcher, _, _ = _tree(tmp_path, data, tag="fb", epochs=1, save_every=2)
+    launcher.launch()
+    weights = tmp_path / "fb" / "v0" / "weights"
+    older, newest = sorted(weights.iterdir())  # 000001, 000003
+
+    corrupt_snapshot(str(newest), mode="uncommit")
+    ok, reason = integrity.verify(str(newest))
+    assert not ok and "uncommitted" in reason
+
+    # Explicit resume from the torn snapshot: quarantined, fallback restores
+    # from 000001 (step 2, batch 2) -> 2 remaining iterations of epoch 0.
+    launcher2, model2, rec2 = _tree(
+        tmp_path, data, tag="fb", epochs=1, resume=str(newest),
+    )
+    launcher2.launch()
+    assert len(rec2.losses) == 2  # resumed from the OLDER snapshot
+    assert int(model2.state.step) == 4
+    assert not newest.exists()
+    assert (weights / f"{newest.name}{integrity.CORRUPT_SUFFIX}").exists()
+
+
+def test_latest_valid_skips_torn_snapshot(tmp_path, devices):
+    data = synthetic_classification(n=256)
+    launcher, _, _ = _tree(tmp_path, data, tag="lv", epochs=1, save_every=2)
+    launcher.launch()
+    root = str(tmp_path / "lv")
+    weights = tmp_path / "lv" / "v0" / "weights"
+    older, newest = sorted(weights.iterdir())
+    assert integrity.latest_valid(root) == str(newest)
+    corrupt_snapshot(str(newest), mode="drop_item")
+    assert integrity.latest_valid(root) == str(older)
+    assert (weights / f"{newest.name}{integrity.CORRUPT_SUFFIX}").exists()
+
+
+def test_deep_verify_catches_garbled_bytes(tmp_path, devices):
+    """Bit rot that keeps marker+manifest intact passes shallow verify but
+    fails the deep checksum pass."""
+    data = synthetic_classification(n=256)
+    launcher, _, _ = _tree(tmp_path, data, tag="gar", epochs=1, save_every=4)
+    launcher.launch()
+    snap = sorted((tmp_path / "gar" / "v0" / "weights").iterdir())[-1]
+    ok, _ = integrity.verify(str(snap), deep=True)
+    assert ok
+    corrupt_snapshot(str(snap), mode="garble")
+    ok, _ = integrity.verify(str(snap))
+    assert ok, "shallow verify cannot see garbled bytes"
+    ok, reason = integrity.verify(str(snap), deep=True)
+    assert not ok and "corrupt" in reason
+
+
+def test_legacy_snapshot_without_manifest_trusted(tmp_path, devices):
+    """A pre-integrity snapshot (no manifest, no marker) is trusted with a
+    warning on explicit restore — old runs stay restorable."""
+    data = synthetic_classification(n=256)
+    launcher, _, _ = _tree(tmp_path, data, tag="leg", epochs=1, save_every=4)
+    launcher.launch()
+    snap = sorted((tmp_path / "leg" / "v0" / "weights").iterdir())[-1]
+    os.remove(snap / integrity.MANIFEST_NAME)
+    os.remove(snap / integrity.COMMIT_MARKER)
+    assert integrity.resolve_restore_path(str(snap)) == str(snap)
+    assert snap.exists()  # trusted, NOT quarantined
+    # ...but auto-resume scans stay strict: an unverifiable snapshot never
+    # wins the newest-valid election.
+    assert integrity.latest_valid(
+        str(tmp_path / "leg"), do_quarantine=False
+    ) != str(snap)
+
+
+# -- preemption + auto-resume ------------------------------------------------
+
+
+def test_sigterm_then_auto_resume_matches_uninterrupted(tmp_path, devices):
+    """THE acceptance chaos test: SIGTERM at iteration k → rerun the same
+    command with ``resume('auto')`` → the stitched loss trajectory equals
+    the uninterrupted run's, and the final params match."""
+    import jax
+
+    data = synthetic_classification(n=256)  # 4 iters/epoch at bs 64
+
+    # Reference: uninterrupted 2-epoch run.
+    launcher_a, model_a, rec_a = _tree(tmp_path, data, tag="ref", epochs=2)
+    launcher_a.launch()
+    assert len(rec_a.losses) == 8
+
+    # Interrupted: SIGTERM lands at iteration 2 of epoch 0.
+    launcher_b, model_b, rec_b = _tree(
+        tmp_path, data, tag="chaos", epochs=2,
+        extra=[SigtermInjector(at_iter=2)],
+    )
+    launcher_b.launch()
+    assert len(rec_b.losses) == 3  # iters 0..2, then the grace-window stop
+    assert model_b.step == 3
+    assert (tmp_path / "chaos" / "v0" / "weights" / "000002").is_dir()
+
+    # Rerun-the-same-command recovery: resume('auto') finds the preemption
+    # snapshot, re-enters epoch 0 at batch 3, finishes both epochs.
+    launcher_c, model_c, rec_c = _tree(
+        tmp_path, data, tag="chaos", epochs=2, resume="auto",
+    )
+    launcher_c.launch()
+    stitched = rec_b.losses + rec_c.losses
+    assert len(stitched) == 8
+    np.testing.assert_allclose(stitched, rec_a.losses, rtol=1e-5, atol=1e-7)
+
+    def flat(params):
+        return np.concatenate([
+            np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(params)
+        ])
+
+    np.testing.assert_allclose(
+        flat(model_c.state.params), flat(model_a.state.params),
+        rtol=1e-5, atol=1e-7,
+    )
+
+
+def test_auto_resume_fresh_start_when_no_snapshot(tmp_path, devices):
+    """resume('auto') over an empty project dir starts fresh instead of
+    crashing — the restart-the-same-command contract."""
+    data = synthetic_classification(n=256)
+    launcher, model, rec = _tree(tmp_path, data, tag="fresh", epochs=1,
+                                 resume="auto")
+    launcher.launch()
+    assert model.step == 4
+    assert len(rec.losses) == 4
+
+
+def test_auto_resume_requires_tag(tmp_path, devices):
+    launcher = rt.Launcher(capsules=[], tag=None, num_epochs=0).resume("auto")
+    with pytest.raises(RuntimeError, match="auto"):
+        launcher.launch()
+
+
+def test_relaunch_in_one_process_after_preemption(tmp_path, devices):
+    """Satellite: the SIGTERM handler chain and the preemption latch both
+    reset across launches in one process — a preempted run followed by a
+    fresh launch must run to completion, and the process handler must be
+    restored after each."""
+    before = signal.getsignal(signal.SIGTERM)
+    data = synthetic_classification(n=256)
+    launcher1, model1, _ = _tree(
+        tmp_path, data, tag="re1", epochs=2,
+        extra=[SigtermInjector(at_iter=1)],
+    )
+    launcher1.launch()
+    assert model1.step == 2  # stopped inside epoch 0
+    assert signal.getsignal(signal.SIGTERM) is before  # handler restored
+
+    # Same process, new launch: must not inherit the stop vote or the
+    # preemption latch.
+    launcher2, model2, _ = _tree(tmp_path, data, tag="re2", epochs=2)
+    launcher2.launch()
+    assert model2.step == 8  # full 2 epochs
+    assert signal.getsignal(signal.SIGTERM) is before
+
+
+def test_stop_vote_honored_between_cycles(tmp_path, devices):
+    """A stop vote cast where no attrs.looper exists (e.g. SIGTERM between
+    cycles) must stop the run before the next epoch starts."""
+
+    class StopVoter(rt.Capsule):
+        def __init__(self):
+            super().__init__(statefull=False, priority=50)
+            self.cycles = 0
+
+        def reset(self, attrs=None):  # fires AFTER the cycle, outside it
+            self.cycles += 1
+            self._runtime.request_stop("test vote between cycles")
+
+    data = synthetic_classification(n=256)
+    voter = StopVoter()
+    launcher, model, _ = _tree(tmp_path, data, tag="vote", epochs=3,
+                               extra=[voter])
+    launcher.launch()
+    assert voter.cycles == 1  # epochs 1 and 2 never started
+    assert model.step == 4
+
+
+# -- divergence: skip / rollback ---------------------------------------------
+
+
+def _direct_module(skip, accum=1):
+    model = rt.Module(
+        MLP(),
+        capsules=[
+            rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+            rt.Optimizer(learning_rate=2e-2),
+        ],
+        skip_nonfinite=skip,
+    )
+    model.bind(rt.Runtime(gradient_accumulation_steps=accum))
+    model.setup()
+    return model
+
+
+def _batches():
+    import jax.numpy as jnp
+
+    data = synthetic_classification(n=64)
+    good = {"x": jnp.asarray(data["x"]), "label": jnp.asarray(data["label"])}
+    bad = {"x": jnp.full_like(good["x"], jnp.nan), "label": good["label"]}
+    return good, bad
+
+
+def test_nan_batch_skipped_state_untouched(devices):
+    """Acceptance: a NaN batch leaves params, optimizer state and the step
+    counter untouched; the next good batch trains normally."""
+    import jax
+
+    good, bad = _batches()
+    model = _direct_module(skip=True)
+    attrs = rt.Attributes(
+        batch=good,
+        looper=rt.Attributes(grad_enabled=True, state=rt.Attributes()),
+    )
+    model.launch(attrs)
+    assert float(attrs.step_logs["skipped"]) == 0.0
+    params1 = jax.tree_util.tree_map(np.asarray, model.state.params)
+    opt1 = jax.tree_util.tree_map(np.asarray, model.state.opt_state)
+
+    attrs.batch = bad
+    model.launch(attrs)
+    assert float(attrs.step_logs["skipped"]) == 1.0
+    assert int(model.state.step) == 1  # update withheld
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, params1,
+        jax.tree_util.tree_map(np.asarray, model.state.params),
+    )
+    jax.tree_util.tree_map(
+        np.testing.assert_array_equal, opt1,
+        jax.tree_util.tree_map(np.asarray, model.state.opt_state),
+    )
+
+    attrs.batch = good
+    model.launch(attrs)
+    assert int(model.state.step) == 2
+    assert np.isfinite(float(attrs.step_logs["loss"]))
+    for leaf in jax.tree_util.tree_leaves(model.state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_nan_microbatch_contributes_zero_gradient(devices):
+    """With accumulation, a NaN micro-batch is dropped from the window sum;
+    the boundary still applies a finite update from the good micro-batches."""
+    import jax
+
+    good, bad = _batches()
+    model = _direct_module(skip=True, accum=2)
+    attrs = rt.Attributes(
+        batch=bad,
+        looper=rt.Attributes(grad_enabled=True, state=rt.Attributes()),
+    )
+    model.launch(attrs)  # micro #1: poisoned, accum stays zero
+    assert float(attrs.step_logs["skipped"]) == 1.0
+    attrs.batch = good
+    model.launch(attrs)  # sync boundary: good grads only
+    assert int(model.state.step) == 1
+    for leaf in jax.tree_util.tree_leaves(model.state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_sentinel_skip_policy_end_to_end(tmp_path, devices):
+    """DivergenceSentinel(policy='skip') arms the in-graph guard through the
+    runtime flag; a poisoned pipeline iteration is skipped and training
+    finishes finite."""
+    import jax
+
+    data = synthetic_classification(n=256)
+    sentinel = rt.DivergenceSentinel(policy="skip")
+    launcher, model, rec = _tree(
+        tmp_path, data, tag="skip", epochs=2,
+        pre_model=[NaNInjector(at_iters=(2,))],
+        extra=[sentinel],
+    )
+    launcher.launch()
+    assert model.step == 7  # 8 iterations, one skipped
+    assert sentinel.events >= 1  # host-side observation of the NaN loss
+    assert np.isfinite(rec.losses[-1])
+    for leaf in jax.tree_util.tree_leaves(model.state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_sentinel_rollback_restores_last_good(tmp_path, devices):
+    """policy='rollback': a NaN batch poisons the params (no skip guard);
+    the sentinel restores the newest valid snapshot, applies the LR
+    cooldown, and training continues finite."""
+    import jax
+
+    data = synthetic_classification(n=256)
+    sentinel = rt.DivergenceSentinel(
+        policy="rollback", spike_factor=None, cooldown_factor=0.1,
+        cooldown_steps=100,
+    )
+    launcher, model, rec = _tree(
+        tmp_path, data, tag="roll", epochs=2,
+        pre_model=[NaNInjector(at_iters=(4,))],
+        extra=[sentinel], save_every=2,
+    )
+    launcher.launch()
+    assert sentinel.rollbacks == 1
+    assert model._lr_scale == 0.1  # cooldown still armed at run end
+    assert np.isfinite(rec.losses[-1])
+    for leaf in jax.tree_util.tree_leaves(model.state.params):
+        assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_sentinel_rollback_without_snapshot_stops(tmp_path, devices):
+    """Divergence with nothing to roll back to must stop the run, not spin."""
+    data = synthetic_classification(n=256)
+    sentinel = rt.DivergenceSentinel(policy="rollback", spike_factor=None)
+    launcher, model, _ = _tree(
+        tmp_path, data, tag="nosnap", epochs=2,
+        pre_model=[NaNInjector(at_iters=(0,))],
+        extra=[sentinel],
+        save_every=100,  # no snapshot ever written
+    )
+    launcher.launch()
+    assert model.step < 8  # stopped early instead of looping on NaN
+
+
+# -- retry / faulty source ---------------------------------------------------
+
+
+def test_transient_source_fault_absorbed(devices):
+    data = synthetic_classification(n=128)
+    source = FaultySource(rt.ArraySource(data), fail_on=(0, 5), times=1)
+    loader = rt.DataLoader(source, batch_size=32, prefetch=0)
+    batches = list(loader.iterate(epoch=0))
+    assert len(batches) == 4
+    assert source.faults == 2  # both scheduled faults fired and were retried
+
+
+def test_persistent_source_fault_surfaces(devices):
+    data = synthetic_classification(n=128)
+    source = FaultySource(rt.ArraySource(data), fail_on=(0,), times=None)
+    loader = rt.DataLoader(source, batch_size=32, prefetch=0)
+    with pytest.raises(OSError, match="injected"):
+        list(loader.iterate(epoch=0))
+    assert source.faults == 3  # the full retry budget, then surfaced
+
+
+def test_retry_call_contract():
+    from rocket_tpu.utils.retry import retry_call
+
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("flap")
+        return "ok"
+
+    assert retry_call(flaky, tries=5, base_delay=0.001) == "ok"
+    assert calls["n"] == 3
+
+    def always():
+        raise OSError("down")
+
+    with pytest.raises(OSError, match="down"):
+        retry_call(always, tries=3, base_delay=0.001)
+    with pytest.raises(ValueError):
+        retry_call(lambda: None, tries=0)
+    # non-retryable exception types propagate immediately
+    calls["n"] = 0
+
+    def bug():
+        calls["n"] += 1
+        raise KeyError("bug")
+
+    with pytest.raises(KeyError):
+        retry_call(bug, tries=5, base_delay=0.001)
+    assert calls["n"] == 1
+
+
+# -- schema tolerance + prune barrier ----------------------------------------
+
+
+def test_schema_tolerant_loads(devices):
+    """Older checkpoints missing keys warn-and-default instead of raising."""
+    looper = rt.Looper(capsules=[], progress=False)
+    looper._iter_idx = 5
+    looper.load_state_dict(rt.Attributes(unrelated=1))
+    assert looper._iter_idx == 5
+
+    ck = rt.Checkpointer(save_every=10)
+    ck._iter_idx = 7
+    ck.load_state_dict(rt.Attributes(unrelated=1))
+    assert ck._iter_idx == 7
+
+    launcher = rt.Launcher(capsules=[])
+    launcher.load_state_dict(rt.Attributes(unrelated=1))
+    assert launcher._epoch_idx == 0
+    assert launcher._saved_num_procs is None  # topology guard skipped
+
+    ds = rt.Dataset(source=rt.ArraySource({"x": np.zeros((4, 2))}))
+    ds._batch_idx = 3
+    ds.load_state_dict(rt.Attributes(unrelated=1))
+    assert ds._batch_idx == 0  # restart the epoch, as the warning says
+
+
+def test_prune_runs_behind_barriers(tmp_path, devices):
+    """Satellite: retention deletes only between collective barriers, so a
+    peer mid-restore can never see its snapshot vanish."""
+    tags = []
+
+    class Recording(rt.Runtime):
+        def wait_for_everyone(self, tag="barrier"):
+            tags.append(tag)
+            super().wait_for_everyone(tag)
+
+    data = synthetic_classification(n=256)
+    model = rt.Module(
+        MLP(),
+        capsules=[
+            rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+            rt.Optimizer(learning_rate=2e-2),
+        ],
+    )
+    looper = rt.Looper(
+        capsules=[
+            rt.Dataset(rt.ArraySource(data), batch_size=64, shuffle=True,
+                       seed=7),
+            model,
+            rt.Checkpointer(save_every=2, keep_last=1),
+        ],
+        progress=False,
+    )
+    rt.Launcher(
+        capsules=[looper], tag="prune", num_epochs=1,
+        project_root=str(tmp_path), runtime=Recording(),
+    ).launch()
+    assert "ckpt-prune" in tags and "ckpt-pruned" in tags
+    assert tags.index("ckpt-prune") < tags.index("ckpt-pruned")
+    weights = tmp_path / "prune" / "v0" / "weights"
+    assert len(list(weights.iterdir())) == 1  # retention applied
+
+
+# -- bench guard: guard costs no traces --------------------------------------
+
+
+def test_skip_guard_zero_extra_traces_happy_path(devices):
+    """Bench-guard: with the skip guard compiled in, N good batches trace the
+    objective exactly ONCE — identical to the unguarded baseline (no per-step
+    retrace, no second step body).  The lr_scale operand costs exactly one
+    extra trace on arming, none on value changes."""
+    import jax.numpy as jnp
+
+    data = synthetic_classification(n=256)
+    batch = {"x": jnp.asarray(data["x"][:64]),
+             "label": jnp.asarray(data["label"][:64])}
+
+    def counting_module(skip):
+        traces = {"n": 0}
+        base = cross_entropy(labels_key="label")
+
+        def objective(b):
+            traces["n"] += 1  # Python body runs at trace time only
+            return base(b)
+
+        model = rt.Module(
+            MLP(),
+            capsules=[
+                rt.Loss(objective, name="ce"),
+                rt.Optimizer(learning_rate=2e-2),
+            ],
+            skip_nonfinite=skip,
+        )
+        model.bind(rt.Runtime())
+        model.setup()
+        return model, traces
+
+    def run(model, n):
+        attrs = rt.Attributes(
+            batch=batch,
+            looper=rt.Attributes(grad_enabled=True, state=rt.Attributes()),
+        )
+        for _ in range(n):
+            attrs.batch = batch
+            model.launch(attrs)
+
+    baseline, base_traces = counting_module(skip=False)
+    run(baseline, 4)
+    guarded, guard_traces = counting_module(skip=True)
+    run(guarded, 4)
+    assert base_traces["n"] == guard_traces["n"] == 1
+
+    # LR cooldown operand: None -> scalar retraces once; new VALUES don't.
+    guarded.set_lr_scale(0.5)
+    run(guarded, 1)
+    assert guard_traces["n"] == 2
+    guarded.set_lr_scale(0.25)
+    run(guarded, 2)
+    assert guard_traces["n"] == 2
+    # and disarming returns to the cached no-operand signature
+    guarded.set_lr_scale(None)
+    run(guarded, 1)
+    assert guard_traces["n"] == 2
+
+
+# -- long chaos sweep (slow) -------------------------------------------------
+
+
+@pytest.mark.slow
+def test_repeated_preemption_cycles(tmp_path, devices):
+    """Three consecutive preempt→auto-resume cycles still converge on the
+    uninterrupted trajectory (run with: pytest -m 'slow and resilience')."""
+    data = synthetic_classification(n=256)
+
+    launcher_a, _, rec_a = _tree(tmp_path, data, tag="sweep-ref", epochs=3)
+    launcher_a.launch()
+
+    losses = []
+    for round_idx, kill_at in enumerate((1, 2, 3)):
+        launcher, _, rec = _tree(
+            tmp_path, data, tag="sweep", epochs=3,
+            extra=[SigtermInjector(at_iter=kill_at)],
+            resume="auto" if round_idx else None,
+        )
+        launcher.launch()
+        losses += rec.losses
+    launcher_f, _, rec_f = _tree(tmp_path, data, tag="sweep", epochs=3,
+                                 resume="auto")
+    launcher_f.launch()
+    losses += rec_f.losses
+    assert len(losses) == len(rec_a.losses)
+    np.testing.assert_allclose(losses, rec_a.losses, rtol=1e-5, atol=1e-7)
