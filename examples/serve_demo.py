@@ -19,6 +19,12 @@ request trace so the two disciplines are directly comparable:
   waits for a group to drain; the demo logs each mid-batch join.
 - ``--mode both`` (default) runs both on the same trace and prints the
   per-request p50 comparison.
+- ``--mode robust`` — the continuous loop wrapped in
+  :class:`rocket_tpu.serve.ServingLoop`: bounded admission queue
+  (``--queue-capacity``), per-request deadlines (``--deadline-ms``),
+  the graceful-degradation ladder, and the stuck-step watchdog
+  (``--watchdog-ms``); ``--stuck-round``/``--burst`` inject live faults
+  and print the SERVING -> DEGRADED -> SERVING health transitions.
 
 Both modes use the int8 self-draft speculative decoder (per-row KV
 frontiers, no per-token host sync) and report per-request latency
@@ -140,7 +146,7 @@ def run_continuous(args, model, draft, params, draft_params,
     warm = jnp.zeros((B, PROMPT), jnp.int32)
     bat.start(warm)
     bat.step()
-    bat.admit(0, warm[:1])
+    bat.admit(0, warm[:1], preempt=True)  # warmup row may still be live
     bat.step()
 
     done_at = np.zeros(R)
@@ -201,6 +207,93 @@ def run_continuous(args, model, draft, params, draft_params,
                 accepted=accepted, drafted=drafted, joins=joins)
 
 
+def run_robust(args, model, draft, params, draft_params, arrivals, prompts):
+    """The continuous loop wrapped in :class:`rocket_tpu.serve.ServingLoop`:
+    bounded admission queue, per-request deadlines, the degradation
+    ladder, and the stuck-step watchdog.  ``--stuck-round K`` wedges the
+    K-th device round via ``StuckStepInjector`` so the watchdog's
+    trip -> fail-in-flight -> rebuild path runs live; ``--burst`` replaces
+    the Poisson trace with deterministic ``bursty_arrivals`` storms that
+    overrun the queue and engage the ladder."""
+    from rocket_tpu.serve import (
+        Completed, DeadlineExceeded, Failed, Overloaded, Request,
+        ServingLoop,
+    )
+    from rocket_tpu.testing.chaos import StuckStepInjector, bursty_arrivals
+
+    R, B = args.requests, args.max_batch
+    wrapped = {"n": 0}
+
+    def factory():
+        bat = ContinuousBatcher(model, draft, params, draft_params,
+                                total_len=PROMPT + NEW, n_draft=NDRAFT)
+        wrapped["n"] += 1
+        if args.stuck_round >= 0 and wrapped["n"] == 1:
+            # wedge only the first instance: the rebuilt batcher is clean
+            return StuckStepInjector(
+                bat, hang_on=(args.stuck_round,),
+                hang_s=args.watchdog_ms / 1e3 * 20,
+            )
+        return bat
+
+    if args.burst > 0:
+        arrivals = np.asarray(bursty_arrivals(
+            R, args.burst, gap_s=args.arrival_ms / 1e3 * args.burst,
+        ))
+    t0 = time.perf_counter()
+
+    def now():
+        return time.perf_counter() - t0
+
+    # the loop's clock shares the demo's time origin, so the printed
+    # deadlines and the loop's eviction decisions line up exactly
+    loop = ServingLoop(
+        factory, max_batch=B, queue_capacity=args.queue_capacity,
+        watchdog_timeout=(args.watchdog_ms / 1e3
+                          if args.stuck_round >= 0 else None),
+        clock=now,
+    )
+    health = loop.health
+    print(f"  [robust] health: {health.value}")
+    submitted = 0
+    results = []
+    while len(results) < R:
+        while submitted < R and arrivals[submitted] <= now():
+            deadline = (None if args.deadline_ms <= 0
+                        else now() + args.deadline_ms / 1e3)
+            loop.submit(Request(rid=submitted,
+                                prompt=prompts[submitted].astype(np.int32),
+                                deadline=deadline))
+            submitted += 1
+        if not loop.run_round() and submitted < R:
+            time.sleep(max(0.0, float(arrivals[submitted]) - now()) + 1e-4)
+        if loop.health is not health:
+            health = loop.health
+            print(f"  [robust] health: {health.value} "
+                  f"(queue {len(loop.queue)}/{loop.queue.capacity}, "
+                  f"ladder '{loop.policy.current.name}', "
+                  f"trips {loop.watchdog.trips})")
+        results.extend(loop.drain_results())
+    total = now()
+    loop.close()
+
+    kinds = {Completed: "completed", Overloaded: "overloaded",
+             DeadlineExceeded: "deadline", Failed: "failed"}
+    tally = {v: 0 for v in kinds.values()}
+    for r in results:
+        tally[kinds[type(r)]] += 1
+    snap = loop.counters.snapshot()
+    print(f"  [robust] results: {tally}")
+    print(f"  [robust] watchdog trips {int(snap['watchdog_trips'])}, "
+          f"degrade peak level {int(snap['degrade_peak'])}, "
+          f"rounds {int(snap['rounds'])}")
+    done = [r for r in results if isinstance(r, Completed)]
+    lat = np.asarray([r.finished_at - arrivals[r.rid] for r in done])
+    return dict(lat=lat * 1e3 if lat.size else np.zeros(1), total=total,
+                dispatches=int(snap["rounds"]), unit="rounds",
+                accepted=0, drafted=0, tally=tally)
+
+
 def _report(name, res, n_requests):
     lat = res["lat"]
     print(f"[{name}] served {n_requests} requests in {res['dispatches']} "
@@ -208,9 +301,10 @@ def _report(name, res, n_requests):
           f"aggregate)")
     print(f"[{name}] latency ms: p50 {np.percentile(lat, 50):.0f}  "
           f"p90 {np.percentile(lat, 90):.0f}  max {lat.max():.0f}")
-    print(f"[{name}] speculative acceptance "
-          f"{res['accepted'] / max(res['drafted'], 1):.0%} "
-          f"(int8 self-draft, n_draft={NDRAFT})")
+    if res["drafted"]:
+        print(f"[{name}] speculative acceptance "
+              f"{res['accepted'] / res['drafted']:.0%} "
+              f"(int8 self-draft, n_draft={NDRAFT})")
     if "joins" in res:
         print(f"[{name}] {res['joins']} requests joined a half-finished "
               f"batch")
@@ -222,8 +316,24 @@ def main():
     parser.add_argument("--max-batch", type=int, default=8)
     parser.add_argument("--arrival-ms", type=float, default=30.0,
                         help="mean simulated inter-arrival gap")
-    parser.add_argument("--mode", choices=("group", "continuous", "both"),
+    parser.add_argument("--mode",
+                        choices=("group", "continuous", "both", "robust"),
                         default="both")
+    parser.add_argument("--queue-capacity", type=int, default=16,
+                        help="[robust] bounded admission queue size; a "
+                             "full queue rejects with a typed Overloaded")
+    parser.add_argument("--deadline-ms", type=float, default=0.0,
+                        help="[robust] per-request deadline (0 = none); "
+                             "late rows are evicted at a round boundary")
+    parser.add_argument("--watchdog-ms", type=float, default=500.0,
+                        help="[robust] stuck-step watchdog poll timeout "
+                             "(armed when --stuck-round >= 0)")
+    parser.add_argument("--stuck-round", type=int, default=-1,
+                        help="[robust] wedge this device round via "
+                             "StuckStepInjector (-1 = no fault)")
+    parser.add_argument("--burst", type=int, default=0,
+                        help="[robust] replace the Poisson trace with "
+                             "deterministic bursts of this size (0 = off)")
     args = parser.parse_args()
 
     # ONE seeded trace shared by both modes: identical arrivals and
@@ -235,7 +345,8 @@ def main():
     prompts = rng.integers(0, VOCAB, size=(args.requests, PROMPT))
     model, draft, params, draft_params = _build()
 
-    runners = {"group": run_group, "continuous": run_continuous}
+    runners = {"group": run_group, "continuous": run_continuous,
+               "robust": run_robust}
     modes = ["group", "continuous"] if args.mode == "both" else [args.mode]
     results = {}
     for m in modes:

@@ -118,10 +118,13 @@ class DivergenceSentinel(Capsule):
         self._seen = 0
         self._ema: Optional[float] = None
         self._staged: Optional[Any] = None
+        self._staged_skip: Optional[Any] = None
         self._streak = 0
         self._cooldown_until: Optional[int] = None
         self.events = 0  # divergences observed (tests / user introspection)
         self.rollbacks = 0
+        self.skips = 0  # in-graph skipped updates observed (policy='skip')
+        self._emitted = (0, 0, 0)  # last (skips, rollbacks, events) flushed
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -139,9 +142,10 @@ class DivergenceSentinel(Capsule):
             )
 
     def reset(self, attrs: Optional[Attributes] = None) -> None:
-        # Cycle boundary: drop the staged device scalar (its buffer may be
-        # donated away between cycles) but keep the EMA across epochs.
+        # Cycle boundary: drop the staged device scalars (their buffers may
+        # be donated away between cycles) but keep the EMA across epochs.
         self._staged = None
+        self._staged_skip = None
         self._streak = 0
 
     # -- iteration -----------------------------------------------------------
@@ -159,25 +163,31 @@ class DivergenceSentinel(Capsule):
             if module is not None:
                 module.set_lr_scale(None)
                 self._logger.info("LR cooldown over — full learning rate")
-        if self._seen % self._check_every != 0:
-            return
-        value = self._stage_and_read(attrs.step_logs.get(self._metric))
-        if value is None:
-            return
-        if self._is_divergent(value):
-            self._streak += 1
-            if self._streak >= self._patience:
-                self._streak = 0
-                self._act(value)
-        else:
-            self._streak = 0
-            self._update_ema(value)
+        skipped = self._stage_and_read(
+            attrs.step_logs.get("skipped"), "_staged_skip"
+        )
+        if skipped is not None and skipped >= 0.5:
+            self.skips += 1
+        if self._seen % self._check_every == 0:
+            value = self._stage_and_read(attrs.step_logs.get(self._metric))
+            if value is not None:
+                if self._is_divergent(value):
+                    self._streak += 1
+                    if self._streak >= self._patience:
+                        self._streak = 0
+                        self._act(value)
+                else:
+                    self._streak = 0
+                    self._update_ema(value)
+        self._emit_scalars(attrs)
 
-    def _stage_and_read(self, current: Any) -> Optional[float]:
-        """Stage this iteration's device scalar, return LAST iteration's as
-        a host float — the transfer overlaps one full step, so the read is
-        free by the time we make it."""
-        staged, self._staged = self._staged, current
+    def _stage_and_read(self, current: Any,
+                        slot: str = "_staged") -> Optional[float]:
+        """Stage this iteration's device scalar in ``slot``, return LAST
+        iteration's as a host float — the transfer overlaps one full step,
+        so the read is free by the time we make it."""
+        staged = getattr(self, slot)
+        setattr(self, slot, current)
         if current is not None:
             start = getattr(current, "copy_to_host_async", None)
             if start is not None:
@@ -191,6 +201,29 @@ class DivergenceSentinel(Capsule):
             return float(staged)
         except (TypeError, ValueError):
             return None
+
+    def _emit_scalars(self, attrs: Attributes) -> None:
+        """Publish sentinel counters through the Tracker's buffered scalar
+        channel — ONLY when one changed, so the steady state appends
+        nothing.  ``sentinel/skips`` counts in-graph skipped updates
+        (engine.step's ``skipped`` log under skip_nonfinite);
+        ``sentinel/rollbacks`` and ``sentinel/events`` the host-side
+        actions."""
+        tracker = getattr(attrs, "tracker", None)
+        if tracker is None:
+            return
+        current = (self.skips, self.rollbacks, self.events)
+        if current == self._emitted:
+            return
+        self._emitted = current
+        tracker.scalars.append(Attributes(
+            step=self._seen,
+            data={
+                "sentinel/skips": float(self.skips),
+                "sentinel/rollbacks": float(self.rollbacks),
+                "sentinel/events": float(self.events),
+            },
+        ))
 
     # -- detection -----------------------------------------------------------
 

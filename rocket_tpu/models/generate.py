@@ -1039,11 +1039,24 @@ class ContinuousBatcher:
     def start(self, prompts) -> None:
         """Prefill the first group (``[B, P]`` int32) and build the
         device-resident round state."""
+        prompts = jnp.asarray(prompts)
+        if prompts.ndim != 2 or prompts.shape[0] < 1 or prompts.shape[1] < 1:
+            raise ValueError(
+                f"start() needs a non-empty [B, P] prompt batch, got "
+                f"shape {tuple(prompts.shape)}"
+            )
+        if not jnp.issubdtype(prompts.dtype, jnp.integer):
+            raise ValueError(
+                f"start() needs integer token ids, got dtype "
+                f"{prompts.dtype}"
+            )
+        prompts = prompts.astype(jnp.int32)
         B, P = prompts.shape
         if P + 1 > self.total_len:
             raise ValueError(
                 f"prompt length {P} + 1 exceeds total_len "
-                f"({self.total_len})"
+                f"({self.total_len}); the buffer needs room for at least "
+                f"one generated token"
             )
         self.state = _spec_prefill(
             self._model, self._draft_model, self._params,
@@ -1063,16 +1076,36 @@ class ContinuousBatcher:
         )
         return np.asarray(self.state[1]), np.asarray(self.state[2])
 
-    def admit(self, row: int, prompt_row) -> None:
+    def admit(self, row: int, prompt_row, *, preempt: bool = False) -> None:
         """Replace row ``row`` with a fresh request (``[1, P]`` or
         ``[P]`` int32) — between rounds, while other rows keep decoding.
-        Admit only rows that are done (or that you mean to preempt): the
-        previous occupant's state is overwritten."""
+        The target row must be finished (its request was harvested);
+        overwriting a LIVE row silently drops its occupant's remaining
+        tokens, so that now requires an explicit ``preempt=True``."""
         if self.state is None:
             raise ValueError("call start() before admit()")
+        B = self.state[0].shape[0]
+        if not 0 <= row < B:
+            # the scatter's .at[row] would drop out-of-bounds writes
+            # SILENTLY inside jit — fail loudly on the host instead
+            raise ValueError(
+                f"admit() row {row} out of range for batch of {B} rows"
+            )
+        if not preempt and not bool(np.asarray(self.state[2])[row]):
+            raise ValueError(
+                f"admit() into row {row} which is still decoding — "
+                f"harvest it first (done flag unset), or pass "
+                f"preempt=True to drop its occupant deliberately"
+            )
         prompt_row = jnp.asarray(prompt_row, jnp.int32)
         if prompt_row.ndim == 1:
             prompt_row = prompt_row[None, :]
+        if prompt_row.ndim != 2 or prompt_row.shape[0] != 1 \
+                or prompt_row.shape[1] < 1:
+            raise ValueError(
+                f"admit() needs a single non-empty prompt row ([P] or "
+                f"[1, P]), got shape {tuple(jnp.asarray(prompt_row).shape)}"
+            )
         if prompt_row.shape[1] + 1 > self.total_len:
             raise ValueError(
                 f"prompt length {prompt_row.shape[1]} + 1 exceeds "
@@ -1091,6 +1124,11 @@ class ContinuousBatcher:
         idles (the round body skips done rows) until the next admit."""
         if self.state is None:
             raise ValueError("call start() before retire()")
+        if not 0 <= row < self.state[0].shape[0]:
+            raise ValueError(
+                f"retire() row {row} out of range for batch of "
+                f"{self.state[0].shape[0]} rows"
+            )
         (buf, n_tok, done, cache_t, cache_d, key, stats) = self.state
         self.state = (buf, n_tok, done.at[row].set(True), cache_t,
                       cache_d, key, stats)

@@ -15,7 +15,7 @@ from rocket_tpu.observe.meter import (
     StatMetric,
 )
 from rocket_tpu.observe.profile import Profiler, Throughput, annotate, debug_mode
-from rocket_tpu.observe.tracker import ImageLogger, Tracker
+from rocket_tpu.observe.tracker import ImageLogger, Tracker, scalar_sink
 
 __all__ = [
     "JsonlBackend",
@@ -37,4 +37,5 @@ __all__ = [
     "TrackerBackend",
     "WandbBackend",
     "get_logger",
+    "scalar_sink",
 ]

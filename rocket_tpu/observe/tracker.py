@@ -115,6 +115,18 @@ class Tracker(Capsule):
             self._backend.log_images(dict(record.data), int(record.step))
 
 
+def scalar_sink(
+    backend: Any = "jsonl", logging_dir: Optional[str] = None
+) -> "TrackerBackend":
+    """Capsule-free scalar sink for code that lives OUTSIDE a train loop
+    (the serving robustness layer flushes its ``serve/*`` counters here).
+    Resolves the same backend specs the :class:`Tracker` capsule accepts
+    (``"jsonl"``, ``"memory"``, a :class:`TrackerBackend` instance, a
+    list) without needing a runtime registry; the caller owns the handle
+    and must ``close()`` it."""
+    return resolve_backend(backend, logging_dir)
+
+
 class ImageLogger(Capsule):
     """Pushes sample images from the batch through the tracker's image
     channel (the producer side of reference ``tracker.py:246-254``).

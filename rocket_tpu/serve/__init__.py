@@ -1,0 +1,42 @@
+"""Serving robustness layer over the continuous-batching decoder.
+
+See :mod:`rocket_tpu.serve.loop` for the architecture and the
+fault-free bit-equality contract; ``docs/reliability.md`` ("Serving
+reliability") for the operator view.
+"""
+
+from rocket_tpu.serve.loop import ServingLoop
+from rocket_tpu.serve.metrics import ServeCounters
+from rocket_tpu.serve.policy import (
+    DEFAULT_LADDER,
+    DegradationLevel,
+    DegradationPolicy,
+)
+from rocket_tpu.serve.queue import AdmissionQueue
+from rocket_tpu.serve.types import (
+    Completed,
+    DeadlineExceeded,
+    Failed,
+    HealthState,
+    Overloaded,
+    Request,
+    Result,
+)
+from rocket_tpu.serve.watchdog import DispatchWatchdog
+
+__all__ = [
+    "AdmissionQueue",
+    "Completed",
+    "DEFAULT_LADDER",
+    "DeadlineExceeded",
+    "DegradationLevel",
+    "DegradationPolicy",
+    "DispatchWatchdog",
+    "Failed",
+    "HealthState",
+    "Overloaded",
+    "Request",
+    "Result",
+    "ServeCounters",
+    "ServingLoop",
+]

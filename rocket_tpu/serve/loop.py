@@ -1,0 +1,451 @@
+"""ServingLoop — the self-healing wrapper around ContinuousBatcher.
+
+The bare :class:`~rocket_tpu.models.generate.ContinuousBatcher` is a
+correctness engine: drive :meth:`step`, harvest finished rows, admit
+replacements.  This module adds everything a request needs to SURVIVE
+contact with production, without touching the traced step body:
+
+- **admission control** — a bounded queue; a full queue (or a draining
+  loop) rejects at submit time with a typed
+  :class:`~rocket_tpu.serve.types.Overloaded`;
+- **deadlines** — absolute timestamps on an injected clock, checked at
+  every round boundary: hopeless queue entries are shed BEFORE they
+  spend a prefill, and in-flight rows past deadline are evicted at the
+  next boundary and returned as
+  :class:`~rocket_tpu.serve.types.DeadlineExceeded` with their partial
+  tokens;
+- **graceful degradation** — a
+  :class:`~rocket_tpu.serve.policy.DegradationPolicy` ladder driven by
+  queue depth and round latency shrinks ``n_draft`` (legal between
+  steps — it is a static jit argname the carried state does not depend
+  on), caps max-new-tokens at admission, and demotes beam requests to
+  the greedy lane;
+- **a dispatch watchdog** — the blocking step + host fetch runs on a
+  worker thread with a timed poll; a wedged dispatch fails the
+  in-flight rows cleanly (partials from the last good host-side carry)
+  and REBUILDS the batcher from the factory.  The rebuilt instance
+  reuses the persistent ``_spec_round`` jit cache (the flax modules
+  hash structurally), so recovery costs a prefill, not a retrace.
+
+Fault-free bit-equality contract: with no deadlines, no faults, and an
+empty-enough queue (degradation level 0), every request served through
+this loop produces tokens BIT-IDENTICAL to the bare batcher — the loop
+only ever calls the public batcher API between rounds, never inside the
+traced step (``tests/test_serving_resilience.py`` enforces this, plus a
+trace-count and host-overhead guard).
+
+All device work stays on the caller/worker thread; the loop itself is
+single-threaded and re-entrant only via :meth:`run_round`.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from rocket_tpu.serve.metrics import ServeCounters
+from rocket_tpu.serve.policy import DegradationPolicy
+from rocket_tpu.serve.queue import AdmissionQueue
+from rocket_tpu.serve.types import (
+    Completed,
+    DeadlineExceeded,
+    Failed,
+    HealthState,
+    Overloaded,
+    Request,
+)
+from rocket_tpu.serve.watchdog import DispatchWatchdog
+
+LOG = logging.getLogger("rocket_tpu.serve")
+
+
+class _Row:
+    """Host-side bookkeeping for one occupied batcher row."""
+
+    __slots__ = ("req", "admitted_at", "prompt_len", "budget",
+                 "requested", "demoted", "rounds_seen")
+
+    def __init__(self, req: Request, admitted_at: float, prompt_len: int,
+                 budget: int, requested: int, demoted: bool) -> None:
+        self.req = req
+        self.admitted_at = admitted_at
+        self.prompt_len = prompt_len
+        self.budget = budget          # new-token cap actually enforced
+        self.requested = requested    # what the caller asked for
+        self.demoted = demoted        # beam request served greedy
+        self.rounds_seen = 0          # carry row valid only after >= 1
+
+
+class ServingLoop:
+    """Robust serving driver over a factory-built ContinuousBatcher.
+
+    ``batcher_factory`` must return a FRESH, un-started
+    ``ContinuousBatcher`` each call — the watchdog recovery path
+    abandons the wedged instance (a zombie worker may still write to
+    it) and rebuilds from the factory.  ``max_batch`` fixes the row
+    count; the loop warm-starts the batcher with a dummy group and
+    serves every real request through :meth:`~ContinuousBatcher.admit`,
+    which keeps each request bit-equal to its solo run regardless of
+    arrival order.
+
+    ``watchdog_timeout`` (seconds) arms the stuck-step detector; first
+    executions of a new ``n_draft`` variant run inline (compiles are
+    slow-by-design, not stuck).  ``beam_fn(prompt_2d, max_new) ->
+    tokens [1, P+T]`` serves ``Request(beam=True)`` at degradation
+    level 0; without it (or degraded) beam requests demote to the
+    greedy lane.  ``sink`` is a tracker backend (``log_scalars``)
+    receiving ``serve/*`` counters every ``flush_every`` rounds.
+    ``clock`` is injectable for deterministic deadline tests; the
+    watchdog always uses real time.
+    """
+
+    def __init__(
+        self,
+        batcher_factory: Callable[[], Any],
+        *,
+        max_batch: int,
+        queue_capacity: int = 64,
+        watchdog_timeout: Optional[float] = None,
+        policy: Optional[DegradationPolicy] = None,
+        beam_fn: Optional[Callable[[np.ndarray, int], np.ndarray]] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sink: Optional[Any] = None,
+        flush_every: int = 8,
+        recover_rounds: int = 4,
+        logger: Optional[logging.Logger] = None,
+    ) -> None:
+        if max_batch < 1:
+            raise ValueError(f"max_batch must be >= 1, got {max_batch}")
+        self._factory = batcher_factory
+        self._max_batch = int(max_batch)
+        self.queue = AdmissionQueue(queue_capacity)
+        self.policy = policy if policy is not None else DegradationPolicy()
+        self.watchdog = DispatchWatchdog(watchdog_timeout)
+        self.counters = ServeCounters()
+        self._beam_fn = beam_fn
+        self._clock = clock
+        self._sink = sink
+        self._flush_every = int(flush_every)
+        self._recover_rounds = int(recover_rounds)
+        self._log = logger if logger is not None else LOG
+
+        self._rows: Dict[int, Optional[_Row]] = {
+            r: None for r in range(self._max_batch)
+        }
+        self._results: List[Any] = []
+        self._draining = False
+        self._recover_in = 0          # rounds left in post-trip DEGRADED
+        self._round_ms: Optional[float] = None  # EMA, shed floor + policy
+        self._carry: Optional[Tuple[np.ndarray, np.ndarray]] = None
+        self._compiled_drafts: set = set()
+
+        self._bat = batcher_factory()
+        self.base_n_draft = int(self._bat.n_draft)
+        self._warm_start(self._bat)
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _warm_start(self, bat: Any) -> None:
+        """Start the batcher on a dummy all-retired group and run one
+        inline round so the base ``n_draft`` executable is warm before
+        the watchdog ever times a dispatch.  Serving everything via
+        ``admit`` afterwards keeps per-request outputs independent of
+        the warm group (admit rebuilds the row's state from scratch)."""
+        warm = np.zeros((self._max_batch, 1), np.int32)
+        bat.start(warm)
+        for r in range(self._max_batch):
+            bat.retire(r)
+        bat.step()  # inline: compile, not serve
+        self._compiled_drafts = {int(bat.n_draft)}
+        self._carry = (np.asarray(bat.state[0]), np.asarray(bat.state[1]))
+
+    @property
+    def health(self) -> HealthState:
+        if self._draining:
+            return HealthState.DRAINING
+        if self._recover_in > 0 or self.policy.level > 0:
+            return HealthState.DEGRADED
+        return HealthState.SERVING
+
+    def drain(self) -> None:
+        """Stop admitting new work; queued + in-flight requests finish."""
+        self._draining = True
+
+    def close(self) -> None:
+        self._flush(force=True)
+        self.watchdog.close()
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, req: Request) -> Optional[Overloaded]:
+        """Enqueue a request.  Returns ``None`` on acceptance, or the
+        typed :class:`Overloaded` rejection (also appended to
+        :meth:`drain_results`) when the queue is full or the loop is
+        draining — admission control answers IMMEDIATELY."""
+        self.counters.submitted += 1
+        if self._draining:
+            rej = Overloaded(req.rid, self._clock(), reason="draining")
+        elif not self.queue.offer(req):
+            rej = Overloaded(req.rid, self._clock(), reason="queue full")
+        else:
+            return None
+        self.counters.shed_overload += 1
+        self._results.append(rej)
+        return rej
+
+    def drain_results(self) -> List[Any]:
+        """Return and clear all typed results produced so far."""
+        out, self._results = self._results, []
+        return out
+
+    # -- the round -----------------------------------------------------
+
+    def run_round(self) -> bool:
+        """One full serving round: shed hopeless queue entries, admit
+        into free rows, dispatch ONE speculative round (under the
+        watchdog once warm), harvest finished / expired / capped rows,
+        update the degradation ladder.  Returns ``True`` if any device
+        work ran (False = completely idle)."""
+        now = self._clock()
+        self._shed_hopeless(now)
+        self._admit_pending(now)
+        if not self._live_rows():
+            self._flush()
+            return False
+
+        ok = self._dispatch()
+        if ok:
+            self._harvest(self._clock())
+            if self._recover_in > 0:
+                self._recover_in -= 1
+        self._update_policy()
+        self._flush()
+        return True
+
+    def run_until_idle(self, max_rounds: int = 10_000) -> List[Any]:
+        """Drive rounds until the queue is empty and no row is live;
+        returns the accumulated typed results."""
+        for _ in range(max_rounds):
+            if not self.queue and not self._live_rows():
+                break
+            self.run_round()
+        else:
+            raise RuntimeError(
+                f"run_until_idle: still busy after {max_rounds} rounds"
+            )
+        return self.drain_results()
+
+    # -- internals -----------------------------------------------------
+
+    def _live_rows(self) -> List[int]:
+        return [r for r, occ in self._rows.items() if occ is not None]
+
+    def _shed_hopeless(self, now: float) -> None:
+        """Queue entries that cannot produce a first round before their
+        deadline are shed pre-prefill — the floor is one observed round
+        (0 until measured, so nothing is shed before evidence exists)."""
+        floor_s = (self._round_ms or 0.0) / 1e3
+        for req in self.queue.shed_hopeless(now, floor_s):
+            self.counters.shed_deadline += 1
+            self._results.append(
+                DeadlineExceeded(req.rid, now, stage="queue")
+            )
+
+    def _admit_pending(self, now: float) -> None:
+        level = self.policy.current
+        for row in list(self._rows):
+            if self._rows[row] is not None:
+                continue
+            # keep popping until this row is filled or the queue empties
+            # (beam-lane serves and at-pop deadline sheds consume the
+            # popped entry without occupying the row)
+            while self._rows[row] is None:
+                req = self.queue.pop()
+                if req is None:
+                    return
+                if req.deadline is not None and req.deadline <= now:
+                    self.counters.shed_deadline += 1
+                    self._results.append(
+                        DeadlineExceeded(req.rid, now, stage="queue")
+                    )
+                elif req.beam and level.beam and self._beam_fn is not None:
+                    self._serve_beam(req, now)
+                else:
+                    self._admit_row(row, req, now)
+
+    def _budget(self, req: Request, prompt_len: int) -> Tuple[int, int]:
+        """(enforced new-token budget, requested new-token count)."""
+        room = self._bat.total_len - prompt_len
+        requested = room if req.max_new_tokens is None \
+            else min(req.max_new_tokens, room)
+        cap = self.policy.current.max_new_cap
+        budget = requested if cap is None else min(requested, cap)
+        return max(1, budget), max(1, requested)
+
+    def _admit_row(self, row: int, req: Request, now: float) -> None:
+        prompt = req.prompt
+        budget, requested = self._budget(req, prompt.shape[0])
+        demoted = bool(req.beam)
+        if demoted:
+            self.counters.beam_demoted += 1
+        self._bat.admit(row, prompt[None, :])
+        self._rows[row] = _Row(req, now, prompt.shape[0], budget,
+                               requested, demoted)
+        self.counters.admitted += 1
+
+    def _serve_beam(self, req: Request, now: float) -> None:
+        """Level-0 beam lane: one inline beam call (its own prefill,
+        not a batcher row).  Under pressure the ladder flips
+        ``beam=False`` and these requests demote to the greedy lane."""
+        budget, _ = self._budget(req, req.prompt.shape[0])
+        toks = np.asarray(self._beam_fn(req.prompt[None, :], budget))
+        toks = toks[0] if toks.ndim == 2 else toks
+        self.counters.admitted += 1
+        self.counters.beam_served += 1
+        self.counters.completed += 1
+        self._results.append(Completed(
+            req.rid, self._clock(), tokens=toks, n_tok=int(toks.shape[0]),
+            via_beam=True,
+        ))
+
+    def _dispatch(self) -> bool:
+        """ONE speculative round + host fetch, watched once the current
+        ``n_draft`` executable is warm.  On a trip or a step exception,
+        fail in-flight rows and rebuild the batcher."""
+        bat = self._bat  # bind NOW: a zombie must not see a rebuilt self._bat
+        n_draft = int(bat.n_draft)
+
+        def _step():
+            n_tok, done = bat.step()
+            return np.asarray(bat.state[0]), n_tok, done
+
+        t0 = time.monotonic()
+        try:
+            if n_draft not in self._compiled_drafts:
+                # first build of this variant: compile inline, unwatched
+                ok, value = True, _step()
+                self._compiled_drafts.add(n_draft)
+            else:
+                ok, value = self.watchdog.run(_step)
+        except Exception as exc:  # step raised on worker/caller thread
+            self._log.warning("serve: step failed: %r", exc)
+            self._fail_inflight(f"step error: {exc!r}")
+            self._rebuild()
+            return False
+        if not ok:
+            self._log.warning(
+                "serve: watchdog trip (> %.3fs); rebuilding batcher",
+                self.watchdog.timeout,
+            )
+            self.counters.watchdog_trips += 1
+            self._fail_inflight("watchdog: stuck device step")
+            self._rebuild()
+            return False
+
+        buf, n_tok, done = value
+        self._carry = (buf, n_tok)
+        round_ms = (time.monotonic() - t0) * 1e3
+        self.counters.observe_round_ms(round_ms)
+        self._round_ms = self.counters.round_ms_ema
+        for occ in self._rows.values():
+            if occ is not None:
+                occ.rounds_seen += 1
+        return True
+
+    def _partial(self, row: int, occ: _Row) -> Tuple[Optional[np.ndarray],
+                                                     int]:
+        """Last-good-carry partial tokens for a row, valid only after
+        the row has survived at least one fetched round (a fresh admit's
+        carry row still holds the previous occupant's data)."""
+        if self._carry is None or occ.rounds_seen < 1:
+            return None, 0
+        buf, n_tok = self._carry
+        n = int(n_tok[row])
+        return np.asarray(buf[row][:n]), n
+
+    def _fail_inflight(self, reason: str) -> None:
+        now = self._clock()
+        for row, occ in self._rows.items():
+            if occ is None:
+                continue
+            toks, n = self._partial(row, occ)
+            self.counters.failed += 1
+            self._results.append(Failed(
+                occ.req.rid, now, tokens=toks, n_tok=n, reason=reason,
+            ))
+            self._rows[row] = None
+
+    def _rebuild(self) -> None:
+        """Abandon the wedged batcher (the zombie worker may still
+        write to it — harmless, nothing reads it) and warm-start a
+        fresh one.  The persistent ``_spec_round`` jit cache keys on
+        structurally-hashed modules, so this does NOT retrace; the cost
+        is one dummy prefill + round."""
+        self._bat = self._factory()
+        self._bat.n_draft = self.policy.n_draft(self.base_n_draft)
+        self._warm_start(self._bat)
+        self._recover_in = self._recover_rounds
+
+    def _harvest(self, now: float) -> None:
+        """Round-boundary accounting: finished rows complete; rows past
+        deadline evict with partials; rows at their (possibly degraded)
+        budget complete as truncated."""
+        n_tok_h = np.asarray(self._bat.state[1])
+        done_h = np.asarray(self._bat.state[2])
+        for row, occ in self._rows.items():
+            if occ is None:
+                continue
+            n = int(n_tok_h[row])
+            produced = n - occ.prompt_len
+            if bool(done_h[row]):
+                toks, nt = self._bat.row_tokens(row)
+                self.counters.completed += 1
+                self._results.append(Completed(
+                    occ.req.rid, now, tokens=toks, n_tok=nt,
+                    beam_demoted=occ.demoted,
+                ))
+                self._rows[row] = None
+            elif occ.req.deadline is not None and occ.req.deadline <= now:
+                toks, nt = self._bat.row_tokens(row)
+                self._bat.retire(row)
+                self.counters.evicted_deadline += 1
+                self._results.append(DeadlineExceeded(
+                    occ.req.rid, now, tokens=toks[:n], n_tok=n,
+                    stage="decode",
+                ))
+                self._rows[row] = None
+            elif produced >= occ.budget:
+                toks, nt = self._bat.row_tokens(row)
+                self._bat.retire(row)
+                truncated = occ.budget < occ.requested
+                if truncated:
+                    self.counters.truncated += 1
+                self.counters.completed += 1
+                self._results.append(Completed(
+                    occ.req.rid, now, tokens=toks, n_tok=nt,
+                    truncated=truncated, beam_demoted=occ.demoted,
+                ))
+                self._rows[row] = None
+
+    def _update_policy(self) -> None:
+        before = self.policy.level
+        level = self.policy.update(self.queue.depth_frac, self._round_ms)
+        if level != before:
+            self._log.info(
+                "serve: degradation %d -> %d (%s)", before, level,
+                self.policy.current.name,
+            )
+        self.counters.observe_level(level)
+        self._bat.n_draft = self.policy.n_draft(self.base_n_draft)
+
+    def _flush(self, force: bool = False) -> None:
+        if self._sink is None:
+            return
+        if force or (self.counters.rounds % self._flush_every == 0):
+            data = {
+                f"serve/{k}": v for k, v in self.counters.snapshot().items()
+            }
+            self._sink.log_scalars(data, step=self.counters.rounds)

@@ -1,0 +1,66 @@
+"""Serving counters — the observability side of every robustness action.
+
+Every shed, eviction, demotion, and watchdog trip increments a counter
+here; :class:`~rocket_tpu.serve.ServingLoop` flushes a snapshot to a
+tracker backend (``serve/*`` scalars) every ``flush_every`` rounds, so
+serving-side faults land in the same pane as the training-side
+``sentinel/*`` scalars (`docs/reliability.md`).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+
+class ServeCounters:
+    """Plain integer counters plus the round-latency EMA.  ``snapshot``
+    returns a flat float dict ready for ``TrackerBackend.log_scalars``.
+    """
+
+    def __init__(self) -> None:
+        self.submitted = 0
+        self.admitted = 0
+        self.completed = 0
+        self.shed_overload = 0      # bounded-queue / draining rejections
+        self.shed_deadline = 0      # shed before prefill (stage='queue')
+        self.evicted_deadline = 0   # evicted mid-decode (stage='decode')
+        self.truncated = 0          # degradation max-new cap cutoffs
+        self.failed = 0             # watchdog / step-error row failures
+        self.watchdog_trips = 0
+        self.beam_served = 0
+        self.beam_demoted = 0
+        self.rounds = 0
+        self.degrade_level = 0
+        self.degrade_peak = 0
+        self.round_ms_ema = 0.0
+
+    def observe_round_ms(self, round_ms: float, decay: float = 0.8) -> None:
+        self.rounds += 1
+        if self.round_ms_ema == 0.0:
+            self.round_ms_ema = round_ms
+        else:
+            self.round_ms_ema = decay * self.round_ms_ema \
+                + (1.0 - decay) * round_ms
+
+    def observe_level(self, level: int) -> None:
+        self.degrade_level = level
+        self.degrade_peak = max(self.degrade_peak, level)
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "submitted": float(self.submitted),
+            "admitted": float(self.admitted),
+            "completed": float(self.completed),
+            "shed_overload": float(self.shed_overload),
+            "shed_deadline": float(self.shed_deadline),
+            "evicted_deadline": float(self.evicted_deadline),
+            "truncated": float(self.truncated),
+            "failed": float(self.failed),
+            "watchdog_trips": float(self.watchdog_trips),
+            "beam_served": float(self.beam_served),
+            "beam_demoted": float(self.beam_demoted),
+            "rounds": float(self.rounds),
+            "degrade_level": float(self.degrade_level),
+            "degrade_peak": float(self.degrade_peak),
+            "round_ms_ema": float(self.round_ms_ema),
+        }

@@ -1,0 +1,122 @@
+"""Graceful-degradation ladder — trade quality for throughput under load.
+
+A serving stack at capacity has exactly three levers that do not drop
+requests: draft less (a shorter speculative chain wastes less verify
+work when acceptance sags under pressure, and shrinks the per-round
+dispatch), emit less (cap max-new-tokens), and search less (beam → the
+greedy continuous lane).  The ladder orders those levers into discrete
+levels; the policy walks up IMMEDIATELY on load signals (queue depth,
+observed round latency) and back down only after ``recover_rounds``
+consecutive calm rounds — hysteresis, so a burst does not make quality
+flap every round.
+
+Level 0 is full quality by contract: with an empty queue and healthy
+round latency the wrapped loop serves exactly what the bare batcher
+serves (the fault-free bit-equality test depends on this).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DegradationLevel:
+    """One rung: ``draft_frac`` scales the batcher's base ``n_draft``
+    (floored at 1 — every level still speculates at least one token);
+    ``max_new_cap`` caps generated tokens per request admitted at this
+    level (``None`` = uncapped); ``beam=False`` demotes beam requests to
+    the greedy continuous lane."""
+
+    name: str
+    draft_frac: float = 1.0
+    max_new_cap: Optional[int] = None
+    beam: bool = True
+
+
+DEFAULT_LADDER: Tuple[DegradationLevel, ...] = (
+    DegradationLevel("full"),
+    DegradationLevel("lean", draft_frac=0.5, beam=False),
+    DegradationLevel("survival", draft_frac=0.25, max_new_cap=64,
+                     beam=False),
+)
+
+
+class DegradationPolicy:
+    """Maps load signals to a ladder level.
+
+    ``engage_depth`` gives the queue-depth fraction at which each level
+    above 0 engages (ascending, one entry per non-zero level).
+    ``round_ms_budget`` is the latency SLO per decode round: level
+    ``min(k, top)`` engages when the observed round takes ``k`` budgets.
+    Escalation is immediate; de-escalation drops ONE level after
+    ``recover_rounds`` consecutive rounds whose signals ask for less.
+    """
+
+    def __init__(
+        self,
+        ladder: Sequence[DegradationLevel] = DEFAULT_LADDER,
+        engage_depth: Sequence[float] = (0.5, 0.875),
+        round_ms_budget: Optional[float] = None,
+        recover_rounds: int = 4,
+    ) -> None:
+        ladder = tuple(ladder)
+        if not ladder:
+            raise ValueError("ladder needs at least one level")
+        if len(engage_depth) != len(ladder) - 1:
+            raise ValueError(
+                f"engage_depth needs one threshold per level above 0: "
+                f"{len(ladder) - 1} levels, got {len(engage_depth)} "
+                f"thresholds"
+            )
+        if list(engage_depth) != sorted(engage_depth):
+            raise ValueError("engage_depth must be ascending")
+        if recover_rounds < 1:
+            raise ValueError("recover_rounds must be >= 1")
+        self.ladder = ladder
+        self._engage_depth = tuple(float(d) for d in engage_depth)
+        self._round_ms_budget = round_ms_budget
+        self._recover_rounds = int(recover_rounds)
+        self._level = 0
+        self._calm = 0
+
+    @property
+    def level(self) -> int:
+        return self._level
+
+    @property
+    def current(self) -> DegradationLevel:
+        return self.ladder[self._level]
+
+    def n_draft(self, base: int) -> int:
+        """The level's effective speculative chain length."""
+        return max(1, int(base * self.current.draft_frac))
+
+    def update(self, depth_frac: float, round_ms: Optional[float] = None
+               ) -> int:
+        """Feed one round's signals; returns the (possibly new) level."""
+        target = 0
+        for i, threshold in enumerate(self._engage_depth, start=1):
+            if depth_frac >= threshold:
+                target = i
+        if (
+            self._round_ms_budget is not None
+            and round_ms is not None
+            and round_ms >= self._round_ms_budget
+        ):
+            lat_target = min(
+                len(self.ladder) - 1, int(round_ms / self._round_ms_budget)
+            )
+            target = max(target, lat_target)
+        if target > self._level:
+            self._level = target
+            self._calm = 0
+        elif target < self._level:
+            self._calm += 1
+            if self._calm >= self._recover_rounds:
+                self._level -= 1
+                self._calm = 0
+        else:
+            self._calm = 0
+        return self._level

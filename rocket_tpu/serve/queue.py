@@ -1,0 +1,71 @@
+"""Bounded admission queue with explicit load shedding.
+
+The failure mode this prevents: an unbounded request queue under a
+traffic burst grows until every request in it is doomed — memory climbs,
+p99 explodes, and by the time a request reaches the device its caller
+hung up long ago.  The fix is the classic one: a hard capacity with an
+IMMEDIATE typed rejection at submit (the caller can retry elsewhere),
+plus deadline-aware shedding at the head — an entry that cannot
+possibly produce its first tokens before its deadline is dropped BEFORE
+it spends a prefill dispatch.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import List, Optional
+
+from rocket_tpu.serve.types import Request
+
+
+class AdmissionQueue:
+    """FIFO of :class:`Request` with a hard ``capacity``.
+
+    The queue itself is dumb on purpose — it accepts or refuses, and it
+    sheds hopeless entries when asked; the :class:`ServingLoop` owns the
+    typed results and the counters, so every shed is accounted for
+    exactly once.
+    """
+
+    def __init__(self, capacity: int) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._items: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    @property
+    def depth_frac(self) -> float:
+        """Queue depth as a fraction of capacity — the degradation
+        ladder's primary load signal."""
+        return len(self._items) / self.capacity
+
+    def offer(self, request: Request) -> bool:
+        """Enqueue; ``False`` when full (the caller sheds with a typed
+        :class:`~rocket_tpu.serve.types.Overloaded`)."""
+        if len(self._items) >= self.capacity:
+            return False
+        self._items.append(request)
+        return True
+
+    def pop(self) -> Optional[Request]:
+        return self._items.popleft() if self._items else None
+
+    def shed_hopeless(self, now: float, floor_s: float) -> List[Request]:
+        """Remove and return every queued request whose deadline cannot
+        possibly be met: ``deadline - now < floor_s``, where ``floor_s``
+        is the loop's estimate of the minimum time to first tokens (one
+        observed decode round).  Entries without deadlines are never
+        shed here."""
+        kept: deque = deque()
+        shed: List[Request] = []
+        while self._items:
+            req = self._items.popleft()
+            if req.deadline is not None and req.deadline - now < floor_s:
+                shed.append(req)
+            else:
+                kept.append(req)
+        self._items = kept
+        return shed

@@ -1,0 +1,120 @@
+"""Typed request/result vocabulary for the serving robustness layer.
+
+Every request submitted to :class:`~rocket_tpu.serve.ServingLoop` is
+accounted for by EXACTLY ONE typed result — robustness must not become
+silence, and it must not become an untyped exception either:
+
+- :class:`Completed` — the request finished (possibly truncated by a
+  degradation cap, possibly served by the beam lane);
+- :class:`Overloaded` — admission control rejected it (bounded queue
+  full, or the loop is draining).  The caller sees the rejection
+  IMMEDIATELY at submit time instead of the queue growing without bound;
+- :class:`DeadlineExceeded` — the deadline passed.  ``stage='queue'``
+  means the entry was shed BEFORE prefill (it could not possibly have
+  met its deadline); ``stage='decode'`` means the row was evicted at the
+  next round boundary, and ``tokens`` carries the partial output;
+- :class:`Failed` — a watchdog trip (or a step exception) killed the
+  in-flight row; ``tokens`` carries the last good host-side partial.
+
+Deadlines are ABSOLUTE timestamps on the loop's injected clock
+(``time.monotonic`` by default), so tests can drive eviction with a fake
+clock while the device work stays real.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Any, Optional
+
+import numpy as np
+
+
+class HealthState(enum.Enum):
+    """Readiness of the serving loop — the state machine the demo (and a
+    real load balancer) watches: ``SERVING`` = full quality, ``DEGRADED``
+    = the ladder is engaged or a watchdog trip is still being recovered
+    from, ``DRAINING`` = no new admissions, in-flight/queued requests
+    finish."""
+
+    SERVING = "serving"
+    DEGRADED = "degraded"
+    DRAINING = "draining"
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request.
+
+    ``prompt`` is a 1-D int32 token array; ``deadline`` is an absolute
+    clock value (``None`` = no deadline); ``max_new_tokens`` caps the
+    output below the batcher's buffer room (``None`` = fill the buffer);
+    ``beam=True`` asks for the beam lane (honored at degradation level 0
+    when the loop has a ``beam_fn``; demoted to the greedy continuous
+    lane otherwise — the result records the demotion).
+    """
+
+    rid: Any
+    prompt: np.ndarray
+    deadline: Optional[float] = None
+    max_new_tokens: Optional[int] = None
+    beam: bool = False
+
+    def __post_init__(self) -> None:
+        prompt = np.asarray(self.prompt, np.int32)
+        if prompt.ndim == 2 and prompt.shape[0] == 1:
+            prompt = prompt[0]
+        if prompt.ndim != 1 or prompt.shape[0] < 1:
+            raise ValueError(
+                f"request {self.rid!r}: prompt must be a non-empty 1-D "
+                f"token array, got shape {np.asarray(self.prompt).shape}"
+            )
+        if self.max_new_tokens is not None and self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.rid!r}: max_new_tokens must be >= 1, got "
+                f"{self.max_new_tokens}"
+            )
+        self.prompt = prompt
+
+
+@dataclasses.dataclass(frozen=True)
+class Result:
+    """Base of the typed result family: which request, and when (on the
+    loop's clock) its fate was decided."""
+
+    rid: Any
+    finished_at: float
+
+
+@dataclasses.dataclass(frozen=True)
+class Completed(Result):
+    """``tokens`` is the fixed-length ``[total_len]`` buffer row
+    (eos-tail-filled, same contract as the one-dispatch path); ``n_tok``
+    the number of real (prompt + generated) tokens.  ``truncated`` marks
+    a degradation-cap cutoff; ``via_beam``/``beam_demoted`` record how a
+    beam request was actually served."""
+
+    tokens: np.ndarray = None
+    n_tok: int = 0
+    via_beam: bool = False
+    beam_demoted: bool = False
+    truncated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class Overloaded(Result):
+    reason: str = "queue full"
+
+
+@dataclasses.dataclass(frozen=True)
+class DeadlineExceeded(Result):
+    tokens: Optional[np.ndarray] = None
+    n_tok: int = 0
+    stage: str = "queue"  # 'queue' = shed before prefill; 'decode' = evicted
+
+
+@dataclasses.dataclass(frozen=True)
+class Failed(Result):
+    tokens: Optional[np.ndarray] = None
+    n_tok: int = 0
+    reason: str = "step failure"

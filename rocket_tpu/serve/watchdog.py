@@ -1,0 +1,99 @@
+"""Dispatch watchdog — a timed poll around the blocking device step.
+
+A wedged device step is the one serving fault the host cannot observe
+from inside: ``block_until_ready`` simply never returns.  The fix is the
+same as for any hung syscall — do the blocking wait on a worker thread
+and give the caller a timed poll.  On timeout the worker is ABANDONED
+(it may be blocked inside the runtime forever; joining it would
+reintroduce the hang), a fresh worker is lazily spawned for the next
+dispatch, and the zombie exits on its own if its call ever completes
+(an ``abandoned`` event checked after each task; its late result goes to
+an orphaned queue nobody reads).
+
+The watchdog times the steady-state dispatch only — callers are
+expected to run first-time executable builds (jit compilation) inline,
+because a compile is slow-by-design, not stuck
+(:class:`~rocket_tpu.serve.ServingLoop` tracks which round variants are
+warm).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Callable, Optional, Tuple
+
+
+class _Worker:
+    """One daemon thread + its private task/result queues.  Private
+    queues make stale results structurally impossible: an abandoned
+    worker's late ``put`` lands where nobody ever reads."""
+
+    _serial = 0
+
+    def __init__(self) -> None:
+        _Worker._serial += 1
+        self.inbox: "queue.Queue" = queue.Queue()
+        self.outbox: "queue.Queue" = queue.Queue()
+        self.abandoned = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"serve-watchdog-{_Worker._serial}",
+        )
+        self._thread.start()
+
+    @property
+    def usable(self) -> bool:
+        return self._thread.is_alive() and not self.abandoned.is_set()
+
+    def _loop(self) -> None:
+        while not self.abandoned.is_set():
+            try:
+                fn = self.inbox.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            try:
+                self.outbox.put((True, fn()))
+            except BaseException as exc:  # surface on the caller thread
+                self.outbox.put((False, exc))
+
+
+class DispatchWatchdog:
+    """``run(fn)`` executes ``fn`` on the worker and waits ``timeout``
+    seconds: ``(True, result)`` on completion, ``(False, None)`` on a
+    trip (``trips`` increments, the worker is quarantined).  Exceptions
+    raised by ``fn`` re-raise on the caller thread.  ``timeout=None``
+    (here or per-call) runs ``fn`` inline with no watching at all."""
+
+    def __init__(self, timeout: Optional[float]) -> None:
+        if timeout is not None and timeout <= 0:
+            raise ValueError(f"timeout must be > 0 (or None), got {timeout}")
+        self.timeout = timeout
+        self.trips = 0
+        self._worker: Optional[_Worker] = None
+
+    def run(self, fn: Callable[[], Any],
+            timeout: Optional[float] = None) -> Tuple[bool, Any]:
+        budget = self.timeout if timeout is None else timeout
+        if budget is None:
+            return True, fn()
+        worker = self._worker
+        if worker is None or not worker.usable:
+            worker = self._worker = _Worker()
+        worker.inbox.put(fn)
+        try:
+            ok, value = worker.outbox.get(timeout=budget)
+        except queue.Empty:
+            self.trips += 1
+            worker.abandoned.set()
+            self._worker = None
+            return False, None
+        if not ok:
+            raise value
+        return True, value
+
+    def close(self) -> None:
+        """Release the worker thread (it exits within its poll tick)."""
+        if self._worker is not None:
+            self._worker.abandoned.set()
+            self._worker = None

@@ -4,6 +4,9 @@ from rocket_tpu.testing.chaos import (
     FaultySource,
     NaNInjector,
     SigtermInjector,
+    SlowSource,
+    StuckStepInjector,
+    bursty_arrivals,
     corrupt_snapshot,
 )
 
@@ -11,5 +14,8 @@ __all__ = [
     "FaultySource",
     "NaNInjector",
     "SigtermInjector",
+    "SlowSource",
+    "StuckStepInjector",
+    "bursty_arrivals",
     "corrupt_snapshot",
 ]

@@ -12,7 +12,13 @@ Three fault families, matching how TPU training actually dies:
   the in-process equivalent of the TPU maintenance event the
   Checkpointer's grace-window path exists for;
 - **numerical poison**: :class:`NaNInjector` overwrites the batch with
-  NaNs at iteration k, driving the DivergenceSentinel / skip-step guard.
+  NaNs at iteration k, driving the DivergenceSentinel / skip-step guard;
+- **serving faults**: :class:`SlowSource` delays scheduled fetches
+  (latency, not failure — the retry path must NOT fire),
+  :class:`StuckStepInjector` wedges scheduled ``ContinuousBatcher.step``
+  calls (driving the serve watchdog's trip-and-rebuild path), and
+  :func:`bursty_arrivals` builds the overload arrival schedules the
+  admission-control tests replay.
 
 Everything here is deterministic (iteration- or call-indexed, never
 random) so chaos tests replay exactly.
@@ -22,7 +28,8 @@ from __future__ import annotations
 
 import os
 import signal
-from typing import Any, Iterable, Optional
+import time
+from typing import Any, Iterable, List, Optional
 
 import numpy as np
 
@@ -77,6 +84,117 @@ class FaultySource:
         value = self._source[index]
         self._pos += 1
         return value
+
+
+class SlowSource:
+    """Wrap a map-style Source; fetches listed in ``slow_on`` sleep
+    ``delay_s`` before returning SUCCESSFULLY.
+
+    The latency sibling of :class:`FaultySource`: a slow edge must be
+    absorbed by deadline accounting (the serving loop's shed floor, the
+    retry ``deadline=``), not by the retry path — nothing here raises.
+    ``slow_on`` indexes the successful-fetch sequence, same convention as
+    ``FaultySource.fail_on``.
+    """
+
+    def __init__(
+        self,
+        source: Any,
+        slow_on: Iterable[int] = (0,),
+        delay_s: float = 0.05,
+        sleep: Any = time.sleep,
+    ) -> None:
+        self._source = source
+        self._slow_on = set(int(i) for i in slow_on)
+        self._delay_s = float(delay_s)
+        self._sleep = sleep
+        self.calls = 0
+        self.stalls = 0  # fetches that actually slept
+        self._pos = 0
+
+    def __len__(self) -> int:
+        return len(self._source)
+
+    def __getitem__(self, index: int) -> Any:
+        self.calls += 1
+        if self._pos in self._slow_on:
+            self.stalls += 1
+            self._sleep(self._delay_s)
+        value = self._source[index]
+        self._pos += 1
+        return value
+
+
+class StuckStepInjector:
+    """Proxy a ``ContinuousBatcher`` and wedge scheduled ``step()`` calls.
+
+    ``hang_on`` indexes the step-call sequence (0 = first ``step()``
+    through this proxy); a scheduled call sleeps ``hang_s`` BEFORE
+    delegating — from the serve watchdog's point of view the dispatch is
+    stuck, the poll times out, and the worker thread carrying this call
+    is abandoned mid-sleep (the sleep finishing later is exactly the
+    zombie-completion case the rebuild path must tolerate).
+
+    Everything else — attribute reads AND writes (the serving loop
+    mutates ``n_draft`` between steps) — delegates to the wrapped
+    batcher, so the proxy drops into any ``batcher_factory``.
+    """
+
+    _OWN = ("_bat", "_hang_on", "_hang_s", "_sleep", "steps", "hangs")
+
+    def __init__(
+        self,
+        batcher: Any,
+        hang_on: Iterable[int] = (0,),
+        hang_s: float = 10.0,
+        sleep: Any = time.sleep,
+    ) -> None:
+        object.__setattr__(self, "_bat", batcher)
+        object.__setattr__(self, "_hang_on",
+                           set(int(i) for i in hang_on))
+        object.__setattr__(self, "_hang_s", float(hang_s))
+        object.__setattr__(self, "_sleep", sleep)
+        object.__setattr__(self, "steps", 0)   # step() calls seen
+        object.__setattr__(self, "hangs", 0)   # calls actually wedged
+
+    def step(self):
+        pos = self.steps
+        object.__setattr__(self, "steps", pos + 1)
+        if pos in self._hang_on:
+            object.__setattr__(self, "hangs", self.hangs + 1)
+            self._sleep(self._hang_s)
+        return self._bat.step()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(object.__getattribute__(self, "_bat"), name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in self._OWN:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._bat, name, value)
+
+
+def bursty_arrivals(
+    n: int,
+    burst: int,
+    gap_s: float,
+    spread_s: float = 0.0,
+    start_s: float = 0.0,
+) -> List[float]:
+    """Arrival offsets (seconds, ascending) for ``n`` requests in bursts
+    of ``burst``, one burst every ``gap_s``; within a burst arrivals are
+    spaced evenly across ``spread_s`` (0 = simultaneous).  Deterministic
+    by construction — the overload tests replay the same storm every
+    run."""
+    if n < 1 or burst < 1:
+        raise ValueError(f"n and burst must be >= 1, got {n}, {burst}")
+    out: List[float] = []
+    for i in range(n):
+        b, j = divmod(i, burst)
+        within = 0.0 if burst == 1 else spread_s * j / burst
+        out.append(start_s + b * gap_s + within)
+    return out
 
 
 def corrupt_snapshot(path: str, mode: str = "uncommit") -> None:

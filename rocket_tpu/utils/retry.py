@@ -15,6 +15,11 @@ Design notes:
   re-create the stampede that caused the timeout;
 - the ``budget`` caps total *sleep* time, independent of ``tries`` — a slow
   edge with a generous ``tries`` must not stall the preemption grace window;
+- ``deadline`` is an *absolute* timestamp on ``clock`` (``time.monotonic``):
+  a retry whose backoff would outlive the caller's deadline raises the last
+  exception instead of sleeping — the serving loop hands its per-request
+  deadlines straight through, so a doomed retry never burns latency the
+  request no longer has;
 - only exception types in ``retry_on`` are retried; everything else (a
   genuine bug, a KeyboardInterrupt) propagates immediately.
 """
@@ -41,8 +46,10 @@ def retry_call(
     base_delay: float = 0.05,
     max_delay: float = 2.0,
     budget: Optional[float] = 30.0,
+    deadline: Optional[float] = None,
     retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON,
     logger: Any = None,
+    clock: Callable[[], float] = time.monotonic,
     **kwargs: Any,
 ) -> Any:
     """Call ``fn(*args, **kwargs)``, retrying ``retry_on`` failures.
@@ -51,7 +58,9 @@ def retry_call(
     ``uniform(0, min(max_delay, base_delay * 2**(k-1)))``.  ``budget``
     bounds the total slept time in seconds (``None`` = unbounded); when the
     budget is exhausted the last exception is raised even if attempts
-    remain.
+    remain.  ``deadline`` (absolute on ``clock``, ``None`` = none) is the
+    caller's own deadline: a backoff that would finish at or past it raises
+    the last exception immediately — retries never outlive the caller.
     """
     if tries < 1:
         raise ValueError("tries must be >= 1")
@@ -72,6 +81,13 @@ def retry_call(
                     budget, attempt + 1, exc,
                 )
                 raise
+            if deadline is not None and clock() + delay >= deadline:
+                log.warning(
+                    "caller deadline would pass during %.3fs backoff "
+                    "(%.3fs remain) after %d attempt(s): %s",
+                    delay, deadline - clock(), attempt + 1, exc,
+                )
+                raise
             log.warning(
                 "transient failure (attempt %d/%d, retrying in %.3fs): %s",
                 attempt + 1, tries, delay, exc,
@@ -86,8 +102,10 @@ def retrying(
     base_delay: float = 0.05,
     max_delay: float = 2.0,
     budget: Optional[float] = 30.0,
+    deadline: Optional[float] = None,
     retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON,
     logger: Any = None,
+    clock: Callable[[], float] = time.monotonic,
 ) -> Callable[[Callable[..., Any]], Callable[..., Any]]:
     """Decorator form of :func:`retry_call`."""
 
@@ -101,8 +119,10 @@ def retrying(
                 base_delay=base_delay,
                 max_delay=max_delay,
                 budget=budget,
+                deadline=deadline,
                 retry_on=retry_on,
                 logger=logger,
+                clock=clock,
                 **kwargs,
             )
 

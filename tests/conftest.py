@@ -34,6 +34,11 @@ def pytest_configure(config):
         "resilience: fault-tolerance / chaos tests (see docs/reliability.md; "
         "long sweeps run with -m 'slow and resilience')",
     )
+    config.addinivalue_line(
+        "markers",
+        "serving: serving-robustness tests (rocket_tpu.serve — deadlines, "
+        "backpressure, watchdog recovery; see docs/reliability.md)",
+    )
 
 
 @pytest.fixture(scope="session")
