@@ -25,6 +25,13 @@ request trace so the two disciplines are directly comparable:
   the graceful-degradation ladder, and the stuck-step watchdog
   (``--watchdog-ms``); ``--stuck-round``/``--burst`` inject live faults
   and print the SERVING -> DEGRADED -> SERVING health transitions.
+- ``--trace`` (implies ``--mode robust``) — arm the structured tracer
+  (:mod:`rocket_tpu.observe.trace`): every round/admit/request gets a
+  span, the demo prints the p50/p95 queue-wait/TTFT/TPOT/e2e table at
+  the end, and a flight-recorder dump (Chrome-trace JSON, open in
+  https://ui.perfetto.dev) is written with its path printed.  Combine
+  with ``--stuck-round`` to see the watchdog-trip crash dump attached
+  to the ``Failed`` results.
 
 Both modes use the int8 self-draft speculative decoder (per-row KV
 frontiers, no per-token host sync) and report per-request latency
@@ -221,6 +228,17 @@ def run_robust(args, model, draft, params, draft_params, arrivals, prompts):
     )
     from rocket_tpu.testing.chaos import StuckStepInjector, bursty_arrivals
 
+    tracer = recorder = None
+    if args.trace:
+        import tempfile
+
+        from rocket_tpu.observe.recorder import FlightRecorder
+        from rocket_tpu.observe.trace import Tracer
+
+        tracer = Tracer(capacity=2048, enabled=True)
+        recorder = FlightRecorder(tracer, out_dir=os.path.join(
+            tempfile.mkdtemp(prefix="serve-demo-"), "flightrec"))
+
     R, B = args.requests, args.max_batch
     wrapped = {"n": 0}
 
@@ -251,7 +269,7 @@ def run_robust(args, model, draft, params, draft_params, arrivals, prompts):
         factory, max_batch=B, queue_capacity=args.queue_capacity,
         watchdog_timeout=(args.watchdog_ms / 1e3
                           if args.stuck_round >= 0 else None),
-        clock=now,
+        clock=now, tracer=tracer, recorder=recorder,
     )
     health = loop.health
     print(f"  [robust] health: {health.value}")
@@ -287,6 +305,25 @@ def run_robust(args, model, draft, params, draft_params, arrivals, prompts):
     print(f"  [robust] watchdog trips {int(snap['watchdog_trips'])}, "
           f"degrade peak level {int(snap['degrade_peak'])}, "
           f"rounds {int(snap['rounds'])}")
+    if args.trace:
+        summary = loop.latency.summary()
+        print("  [trace] request latency percentiles (ms):")
+        print(f"  [trace]   {'metric':<14} {'p50':>8} {'p95':>8}")
+        for name in ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
+            p50 = summary.get(f"{name}/p50")
+            if p50 is not None:
+                print(f"  [trace]   {name:<14} {p50:8.1f} "
+                      f"{summary[f'{name}/p95']:8.1f}")
+        crash = [r.dump_path for r in results
+                 if isinstance(r, Failed) and r.dump_path]
+        if crash:
+            print(f"  [trace] crash dump (attached to Failed results) -> "
+                  f"{crash[0]}")
+        dump = recorder.dump("demo-exit")
+        print(f"  [trace] flight-recorder dump -> {dump}")
+        print("  [trace] open trace.json in https://ui.perfetto.dev "
+              "(merge per-host dumps: python -m rocket_tpu.observe.trace "
+              "<dir>)")
     done = [r for r in results if isinstance(r, Completed)]
     lat = np.asarray([r.finished_at - arrivals[r.rid] for r in done])
     return dict(lat=lat * 1e3 if lat.size else np.zeros(1), total=total,
@@ -334,7 +371,16 @@ def main():
     parser.add_argument("--burst", type=int, default=0,
                         help="[robust] replace the Poisson trace with "
                              "deterministic bursts of this size (0 = off)")
+    parser.add_argument("--trace", action="store_true",
+                        help="arm the structured tracer: per-request "
+                             "spans, a p50/p95 TTFT/TPOT table, and a "
+                             "flight-recorder dump path at exit "
+                             "(implies --mode robust)")
     args = parser.parse_args()
+    if args.trace and args.mode != "robust":
+        print("--trace instruments the robust loop; switching to "
+              "--mode robust")
+        args.mode = "robust"
 
     # ONE seeded trace shared by both modes: identical arrivals and
     # prompts make the p50s directly comparable

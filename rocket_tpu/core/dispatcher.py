@@ -19,6 +19,20 @@ from typing import Any, Iterable, List, Optional
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
 
+# Lazy handle to observe.trace.get_tracer — resolved on first traced
+# dispatch, NOT at import (rocket_tpu.observe imports core capsules, so a
+# top-level import here would be circular).
+_GET_TRACER = None
+
+
+def _tracer():
+    global _GET_TRACER
+    if _GET_TRACER is None:
+        from rocket_tpu.observe.trace import get_tracer
+
+        _GET_TRACER = get_tracer
+    return _GET_TRACER()
+
 
 class Dispatcher(Capsule):
     """Composite capsule: holds an ordered list of children and dispatches
@@ -38,30 +52,44 @@ class Dispatcher(Capsule):
 
     # -- lifecycle fan-out --------------------------------------------------
 
+    def _event(self, capsule: Capsule, event: str,
+               attrs: Optional[Attributes]) -> None:
+        """Dispatch one lifecycle event to one child, wrapped in a tracer
+        span when the bound runtime armed ``tracing`` (ISSUE 4: automatic
+        capsule instrumentation, zero cost when disarmed)."""
+        if self._runtime is not None and getattr(
+            self._runtime, "tracing", False
+        ):
+            name = f"{type(capsule).__name__}.{event}"
+            with _tracer().span(name, cat="capsule"):
+                getattr(capsule, event)(attrs)
+        else:
+            getattr(capsule, event)(attrs)
+
     def setup(self, attrs: Optional[Attributes] = None) -> None:
         super().setup(attrs)
         for capsule in self._capsules:
-            capsule.setup(attrs)
+            self._event(capsule, "setup", attrs)
 
     def destroy(self, attrs: Optional[Attributes] = None) -> None:
         for capsule in reversed(self._capsules):
-            capsule.destroy(attrs)
+            self._event(capsule, "destroy", attrs)
         super().destroy(attrs)
 
     def set(self, attrs: Optional[Attributes] = None) -> None:
         super().set(attrs)
         for capsule in self._capsules:
-            capsule.set(attrs)
+            self._event(capsule, "set", attrs)
 
     def reset(self, attrs: Optional[Attributes] = None) -> None:
         super().reset(attrs)
         for capsule in self._capsules:
-            capsule.reset(attrs)
+            self._event(capsule, "reset", attrs)
 
     def launch(self, attrs: Optional[Attributes] = None) -> None:
         super().launch(attrs)
         for capsule in self._capsules:
-            capsule.launch(attrs)
+            self._event(capsule, "launch", attrs)
 
     # -- runtime ------------------------------------------------------------
 

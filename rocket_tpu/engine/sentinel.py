@@ -251,6 +251,7 @@ class DivergenceSentinel(Capsule):
 
     def _act(self, value: float) -> None:
         self.events += 1
+        self._record_event(value)
         if self._policy in ("warn", "skip"):
             # Under 'skip' the in-graph guard already protected the state;
             # this is the host-side observation of the same event.
@@ -263,6 +264,28 @@ class DivergenceSentinel(Capsule):
                 )
             return
         self._rollback(value)
+
+    def _record_event(self, value: float) -> None:
+        """Flight-recorder hook: a divergence event marks the timeline and
+        dumps the last-N host events — the 'what was the system doing
+        right before the loss blew up' artifact (ISSUE 4).  Lazy imports
+        keep engine free of observe at import time; both calls are no-ops
+        unless tracing armed a tracer / a Launcher installed a recorder."""
+        try:
+            from rocket_tpu.observe.recorder import active_recorder
+            from rocket_tpu.observe.trace import get_tracer
+
+            get_tracer().instant(
+                "sentinel/divergence", metric=self._metric, value=value,
+                event=self.events, policy=self._policy,
+            )
+            rec = active_recorder()
+            if rec is not None:
+                rec.dump(f"sentinel-{self._policy}")
+        except Exception:  # observability must never break the run
+            self._logger.warning(
+                "sentinel: flight-recorder dump failed", exc_info=True
+            )
 
     def _rollback(self, value: float) -> None:
         from rocket_tpu.persist import integrity

@@ -63,6 +63,7 @@ class Launcher(Dispatcher):
         mixed_precision: str = "no",
         gradient_accumulation_steps: int = 1,
         seed: int = 0,
+        tracing: bool = False,
         project_root: str = "experiments",
         runtime: Optional[Runtime] = None,
         statefull: bool = True,
@@ -78,6 +79,7 @@ class Launcher(Dispatcher):
         self._mixed_precision = mixed_precision
         self._grad_accum = int(gradient_accumulation_steps)
         self._seed = int(seed)
+        self._tracing = bool(tracing)
         self._project_root = project_root
         self._external_runtime = runtime
         self._epoch_idx = 0
@@ -123,6 +125,7 @@ class Launcher(Dispatcher):
             mixed_precision=self._mixed_precision,
             gradient_accumulation_steps=self._grad_accum,
             seed=self._seed,
+            tracing=self._tracing,
         )
         runtime.project_dir = self._resolve_project_dir()
         if runtime.project_dir is not None:
@@ -133,6 +136,8 @@ class Launcher(Dispatcher):
         runtime.stop_reason = None
         self.bind(runtime)
         self._create_project_dir(runtime)
+        if getattr(runtime, "tracing", False):
+            self._arm_flight_recorder(runtime)
         if self._resume_path is not None:
             resolved = self._resolve_resume_path(runtime)
             if resolved is not None:
@@ -141,6 +146,30 @@ class Launcher(Dispatcher):
                     load_capsules=self._resume_load_capsules,
                 )
         super().setup(attrs)
+
+    def _arm_flight_recorder(self, runtime: Runtime) -> None:
+        """Tracing armed: stamp the cross-host merge anchor at a barrier
+        (every host anchors the same instant, up to barrier skew — the
+        alignment point ``merge_traces`` uses) and install the process
+        flight recorder writing to ``<project>/logs/flightrec`` (ISSUE 4).
+        Lazy imports: launch must not pull observe in for untraced runs."""
+        from rocket_tpu.observe import recorder as flightrec
+        from rocket_tpu.observe.trace import arm
+
+        tracer = arm()  # external Runtime with tracing=True set post-init
+        runtime.wait_for_everyone("trace-anchor")
+        tracer.set_anchor()
+        base = runtime.logging_dir or os.path.join(
+            self._project_root, "logs"
+        )
+        rec = flightrec.FlightRecorder(
+            tracer, out_dir=os.path.join(base, "flightrec"),
+            logger=self._logger,
+        )
+        flightrec.install(rec)
+        self._logger.info(
+            "tracing armed: flight recorder -> %s", rec.out_dir
+        )
 
     def _resolve_resume_path(self, runtime: Runtime) -> Optional[str]:
         """Turn the armed resume request into a VERIFIED snapshot path.
@@ -339,9 +368,26 @@ class Launcher(Dispatcher):
                 )
             if not stopped:
                 self._epoch_idx = self._num_epochs
+        except Exception:
+            # Unhandled launch exception: the flight recorder's last-N
+            # window IS the post-mortem — dump before teardown can run
+            # (destroy may raise again or block on checkpoint drain).
+            self._dump_flight_recorder("exception")
+            raise
         finally:
             del attrs.launcher
             self.destroy(attrs)
+
+    def _dump_flight_recorder(self, reason: str) -> None:
+        from rocket_tpu.observe.recorder import active_recorder
+
+        rec = active_recorder()
+        if rec is None:
+            return
+        try:
+            rec.dump(reason)
+        except Exception:  # a failing dump must not mask the real error
+            self._logger.warning("flight recorder dump failed", exc_info=True)
 
     # -- state ---------------------------------------------------------------
 

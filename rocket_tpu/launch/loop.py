@@ -148,6 +148,15 @@ class Looper(Dispatcher):
             self.set(attrs)
         looper = attrs.looper
         bar = self._status_bar(looper.repeats)
+        # Hoisted per cycle: the per-iteration loop is the train hot path,
+        # so the tracing-armed check must not repeat per capsule per step.
+        traced = self._runtime is not None and getattr(
+            self._runtime, "tracing", False
+        )
+        if traced:
+            from rocket_tpu.core.dispatcher import _tracer
+
+            tracer = _tracer()
         try:
             # repeats=None: unbounded streaming cycle, ended by the child
             # Dataset's termination vote when the stream exhausts.
@@ -158,8 +167,17 @@ class Looper(Dispatcher):
                 # the previous iteration's logs to observers downstream
                 # (trackers, sentinels) as if a step had happened.
                 attrs.step_logs = None
-                for capsule in self._capsules:
-                    capsule.launch(attrs)
+                if traced:
+                    with tracer.span(
+                        f"looper/{self._tag}/iter", iter=self._iter_idx
+                    ):
+                        for capsule in self._capsules:
+                            name = f"{type(capsule).__name__}.launch"
+                            with tracer.span(name, cat="capsule"):
+                                capsule.launch(attrs)
+                else:
+                    for capsule in self._capsules:
+                        capsule.launch(attrs)
                 self._iter_idx += 1
                 if looper.terminate or (
                     self._runtime is not None and self._runtime.stop_training
@@ -200,12 +218,18 @@ class Looper(Dispatcher):
     def _format_state(state: Optional[Attributes]) -> dict:
         if not state:
             return {}
+        from rocket_tpu.observe.profile import annotate
+
         out = {}
-        for key, value in state.items():
-            try:
-                out[key] = f"{float(value):.4g}"  # device sync, throttled
-            except (TypeError, ValueError):
-                out[key] = str(value)
+        # The float() calls below are the loop's only host-fetch boundary;
+        # the annotation makes the (throttled) sync attributable in a
+        # profiler timeline instead of smearing into the next dispatch.
+        with annotate("looper/host_fetch"):
+            for key, value in state.items():
+                try:
+                    out[key] = f"{float(value):.4g}"  # device sync, throttled
+                except (TypeError, ValueError):
+                    out[key] = str(value)
         return out
 
     # -- state ---------------------------------------------------------------

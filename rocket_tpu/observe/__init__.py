@@ -15,6 +15,16 @@ from rocket_tpu.observe.meter import (
     StatMetric,
 )
 from rocket_tpu.observe.profile import Profiler, Throughput, annotate, debug_mode
+from rocket_tpu.observe.recorder import FlightRecorder, active_recorder
+from rocket_tpu.observe.trace import (
+    Histogram,
+    Tracer,
+    arm,
+    disarm,
+    get_tracer,
+    merge_traces,
+    span,
+)
 from rocket_tpu.observe.tracker import ImageLogger, Tracker, scalar_sink
 
 __all__ = [
@@ -38,4 +48,13 @@ __all__ = [
     "WandbBackend",
     "get_logger",
     "scalar_sink",
+    "FlightRecorder",
+    "active_recorder",
+    "Histogram",
+    "Tracer",
+    "arm",
+    "disarm",
+    "get_tracer",
+    "merge_traces",
+    "span",
 ]

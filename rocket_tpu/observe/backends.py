@@ -18,7 +18,15 @@ import numpy as np
 
 
 class TrackerBackend:
-    """Protocol: scalar/image sinks + close."""
+    """Protocol: scalar/image sinks + close.
+
+    Every backend is also a context manager (``__exit__`` → ``close``),
+    so ``scalar_sink`` callers outside a capsule tree — serve loops,
+    scripts — can't leak a file/writer handle::
+
+        with scalar_sink("jsonl", logging_dir) as sink:
+            loop = ServingLoop(..., sink=sink)
+    """
 
     def log_scalars(self, data: Dict[str, Any], step: int) -> None:
         raise NotImplementedError
@@ -28,6 +36,13 @@ class TrackerBackend:
 
     def close(self) -> None:
         pass
+
+    def __enter__(self) -> "TrackerBackend":
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        self.close()
+        return False
 
 
 class TensorBoardBackend(TrackerBackend):

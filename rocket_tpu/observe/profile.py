@@ -64,20 +64,51 @@ class Profiler(Capsule):
     def launch(self, attrs: Optional[Attributes] = None) -> None:
         if self._done:
             return
-        if not self._active and self._iter == self._start:
-            if self._runtime is None or self._runtime.is_main_process:
-                jax.profiler.start_trace(self._log_dir)
-                self._active = True
-                self._logger.info("profiler trace started -> %s", self._log_dir)
+        if not self._active and self._iter >= self._start:
+            # '>=' not '==': a cycle boundary landing exactly on _start
+            # (reset bumps nothing, but set/launch interleavings can skip
+            # an iteration) must not silently lose the whole window.
+            if self._runtime is not None and not self._runtime.is_main_process:
+                # Non-main processes never capture — say so ONCE instead
+                # of silently doing nothing every iteration (ISSUE 4
+                # satellite), and mark done so the check stops.
+                self._done = True
+                self._logger.info(
+                    "profiler: process %d is not the main process — "
+                    "skipping trace capture", self._runtime.process_index,
+                )
+            else:
+                try:
+                    jax.profiler.start_trace(self._log_dir)
+                except Exception:
+                    # A failed start (e.g. a second start_trace elsewhere
+                    # in the process) disables this Profiler instead of
+                    # re-raising every remaining iteration.
+                    self._done = True
+                    self._logger.warning(
+                        "profiler: start_trace failed — disabled",
+                        exc_info=True,
+                    )
+                else:
+                    self._active = True
+                    self._logger.info(
+                        "profiler trace started -> %s", self._log_dir
+                    )
         elif self._active and self._iter >= self._start + self._count:
             self._stop()
         self._iter += 1
 
     def _stop(self) -> None:
-        if self._active:
+        if not self._active:
+            return
+        # Flags first: whatever stop_trace does, this Profiler is finished
+        # — a raising stop_trace must not leave _active=True (the next
+        # reset/destroy would double-stop and mask the original error).
+        self._active = False
+        self._done = True
+        try:
             jax.profiler.stop_trace()
-            self._active = False
-            self._done = True
+        finally:
             self._logger.info("profiler trace written -> %s", self._log_dir)
 
     def reset(self, attrs: Optional[Attributes] = None) -> None:
@@ -107,11 +138,24 @@ class Throughput(Capsule):
         self._log_every = log_every
         self._last_time: Optional[float] = None
         self._ema: Optional[float] = None
-        self._iter = 0
+        self._iter = 0          # within-cycle counter (log_every cadence)
+        self._global_iter = 0   # record step: never resets, so a second
+        # cycle's scalars don't overwrite the first's (last-write-wins in
+        # TensorBoard) — the ImageLogger uses the same two-counter scheme
+        self._last_dt: Optional[float] = None
+        self._pending = False   # readings observed since the last record
 
     def set(self, attrs: Optional[Attributes] = None) -> None:
+        # Full cycle-boundary reset — including ``_iter``: leaving it
+        # nonzero skewed the ``log_every`` alignment of every later cycle
+        # (a 30-iter cycle left ``_iter=30``; with ``log_every=50`` the
+        # next cycle's first record then fired after 20 iterations and
+        # drifted from there — ISSUE 4 satellite).
         self._last_time = None
         self._ema = None
+        self._iter = 0
+        self._last_dt = None
+        self._pending = False
 
     def launch(self, attrs: Optional[Attributes] = None) -> None:
         now = time.perf_counter()
@@ -129,6 +173,9 @@ class Throughput(Capsule):
             else self._ema_factor * self._ema + (1 - self._ema_factor) * rate
         )
         self._iter += 1
+        self._global_iter += 1
+        self._last_dt = dt
+        self._pending = True
         if attrs is None:
             return
         looper = attrs.looper
@@ -138,15 +185,30 @@ class Throughput(Capsule):
             attrs.tracker is not None
             and self._iter % self._log_every == 0
         ):
-            attrs.tracker.scalars.append(
-                Attributes(
-                    step=self._iter,
-                    data={
-                        f"{self._tag}/samples_per_sec": self._ema,
-                        f"{self._tag}/step_ms": dt * 1e3,
-                    },
-                )
+            self._record(attrs)
+
+    def reset(self, attrs: Optional[Attributes] = None) -> None:
+        # Cycle end: flush the sub-``log_every`` remainder so short loops
+        # (repeats < log_every) still produce at least one throughput
+        # scalar instead of none (ISSUE 4 satellite).
+        if (
+            self._pending
+            and attrs is not None
+            and attrs.tracker is not None
+        ):
+            self._record(attrs)
+
+    def _record(self, attrs: Attributes) -> None:
+        self._pending = False
+        attrs.tracker.scalars.append(
+            Attributes(
+                step=self._global_iter,
+                data={
+                    f"{self._tag}/samples_per_sec": self._ema,
+                    f"{self._tag}/step_ms": (self._last_dt or 0.0) * 1e3,
+                },
             )
+        )
 
 
 def _batch_size(batch: Any) -> int:

@@ -1,0 +1,151 @@
+"""Flight recorder — dump the tracer ring when something goes wrong.
+
+An aircraft flight recorder is useless in steady flight and priceless
+after a crash; same here.  The :class:`~rocket_tpu.observe.trace.Tracer`
+keeps the last-N host events at near-zero cost; this module turns that
+ring into an on-disk artifact at the moments that matter:
+
+- a :class:`~rocket_tpu.serve.watchdog.DispatchWatchdog` trip (the serve
+  loop dumps, then attaches the path to every ``Failed`` result);
+- a :class:`~rocket_tpu.engine.sentinel.DivergenceSentinel` event;
+- an unhandled exception escaping ``Launcher.launch``;
+- SIGTERM (preemption) — chained AFTER any previously-installed handler
+  exactly like the Checkpointer's preemption hook, so both fire.
+
+Each dump is a directory ``<out_dir>/<stamp>-<seq>-<reason>-p<proc>/``
+holding ``trace.json`` (Chrome-trace / Perfetto catapult format) and
+``tail.txt`` (human-readable last events).  Per-host dumps from one
+incident share the parent dir; ``python -m rocket_tpu.observe.trace
+<out_dir>`` merges them onto one barrier-aligned timeline.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import signal
+import threading
+import time
+from typing import Any, Optional
+
+from rocket_tpu.observe.trace import Tracer, _process_index, get_tracer
+
+LOG = logging.getLogger("rocket_tpu.observe.recorder")
+
+
+def _slug(reason: str) -> str:
+    slug = re.sub(r"[^A-Za-z0-9_-]+", "-", reason.strip()).strip("-")
+    return (slug or "dump")[:48]
+
+
+class FlightRecorder:
+    """Owns an output directory and writes crash dumps from a tracer.
+
+    ``dump`` is safe to call from any thread (one lock serializes
+    writers — dumping is cold-path by definition) and from a signal
+    handler (everything it does is plain file I/O).  A disabled tracer
+    still dumps whatever the ring holds — usually nothing, never an
+    error.
+    """
+
+    def __init__(
+        self,
+        tracer: Optional[Tracer] = None,
+        out_dir: str = "flightrec",
+        tail: int = 48,
+        logger: Optional[logging.Logger] = None,
+    ) -> None:
+        self._tracer = tracer if tracer is not None else get_tracer()
+        self.out_dir = out_dir
+        self._tail = int(tail)
+        self._log = logger if logger is not None else LOG
+        self._lock = threading.Lock()
+        self._seq = 0
+        self.last_dump: Optional[str] = None
+
+    @property
+    def tracer(self) -> Tracer:
+        return self._tracer
+
+    def dump(self, reason: str = "manual") -> str:
+        """Write the current ring as ``trace.json`` + ``tail.txt``;
+        returns the dump directory path."""
+        with self._lock:
+            self._seq += 1
+            name = (
+                f"{time.strftime('%Y%m%d-%H%M%S')}-{self._seq:03d}-"
+                f"{_slug(reason)}-p{_process_index()}"
+            )
+            path = os.path.join(self.out_dir, name)
+            os.makedirs(path, exist_ok=True)
+            doc_path = os.path.join(path, "trace.json")
+            doc = self._tracer.to_chrome()
+            doc["metadata"]["dump_reason"] = reason
+            with open(doc_path, "w") as f:
+                json.dump(doc, f, default=str)
+            with open(os.path.join(path, "tail.txt"), "w") as f:
+                f.write(f"flight recorder dump — reason: {reason}\n")
+                f.write(self._tracer.tail_text(self._tail))
+            self.last_dump = path
+            self._log.warning("flight recorder dump (%s) -> %s", reason, path)
+            return path
+
+
+# -- process-global recorder + SIGTERM chaining ------------------------------
+
+_ACTIVE: Optional[FlightRecorder] = None
+# Same chaining discipline as persist.checkpoint: remember whatever handler
+# was installed before us and call it after the dump, so a preemption still
+# reaches the Checkpointer's snapshot path (or vice versa, whichever
+# installed first).
+_PREV_SIGTERM = {"handler": None}
+
+
+def active_recorder() -> Optional[FlightRecorder]:
+    """The installed process-global recorder (``None`` = not armed)."""
+    return _ACTIVE
+
+
+def install(recorder: FlightRecorder, sigterm: bool = True) -> FlightRecorder:
+    """Make ``recorder`` the process-global crash sink and (optionally)
+    hook SIGTERM.  Re-installing replaces the recorder but never stacks
+    signal handlers."""
+    global _ACTIVE
+    _ACTIVE = recorder
+    if sigterm:
+        _install_sigterm()
+    return recorder
+
+
+def uninstall() -> None:
+    """Detach the global recorder (the SIGTERM hook stays installed but
+    becomes a pass-through to the previous handler)."""
+    global _ACTIVE
+    _ACTIVE = None
+
+
+def _on_sigterm(signum: int, frame: Any) -> None:
+    rec = _ACTIVE
+    if rec is not None:
+        try:
+            rec.dump("sigterm")
+        except Exception:
+            pass  # a failing dump must never mask the preemption path
+    prev = _PREV_SIGTERM["handler"]
+    if callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN):
+        prev(signum, frame)
+
+
+def _install_sigterm() -> None:
+    if threading.current_thread() is not threading.main_thread():
+        return  # signal.signal is main-thread-only; skip quietly
+    try:
+        current = signal.getsignal(signal.SIGTERM)
+        if current is _on_sigterm:
+            return  # already hooked — keep the original chain target
+        _PREV_SIGTERM["handler"] = current
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # exotic embedders
+        pass

@@ -1,0 +1,453 @@
+"""Structured host-side tracing — spans, counters, a bounded ring buffer,
+Chrome-trace export, and cross-host timeline merge.
+
+The profiler story so far captures DEVICE time (``Profiler`` wraps
+``jax.profiler`` XPlane windows); this module captures the HOST side —
+what the dispatch loop, the serve loop, and each capsule were doing, in
+wall-clock order, in the seconds before something went wrong.  Production
+TPU serving and MPMD-scale training both treat per-phase latency
+attribution and cross-host timeline correlation as table stakes
+(PAPERS.md: arxiv 2605.25645 §serving, 2412.14374 §debugging); the
+reference rocket has neither.
+
+Design constraints (ISSUE 4 tentpole):
+
+- **lock-light**: events append to a ``collections.deque(maxlen=N)`` —
+  a single bytecode-atomic operation under CPython, so the serve loop's
+  caller thread and the watchdog worker thread can both record without a
+  mutex on the hot path;
+- **zero device syncs**: every stamp is ``time.perf_counter_ns()``; no
+  jax call appears anywhere on the recording path (``jax.process_index``
+  is consulted only at dump time, with a safe fallback);
+- **cheap when disarmed**: ``span()`` on a disabled tracer returns one
+  shared no-op context manager — no allocation, no clock read;
+- **bounded**: the ring keeps the last ``capacity`` events; a flight
+  recorder dump is therefore always a recent-history window, never an
+  unbounded log.
+
+Multi-host correlation: each host's monotonic clock has an arbitrary
+origin, so raw timestamps from two hosts cannot be compared.  The
+Launcher calls :meth:`Tracer.set_anchor` immediately after a cross-host
+barrier — every host stamps (wall time, monotonic time) at what is the
+same instant up to barrier skew — and :func:`merge_traces` shifts each
+per-host dump so the anchors coincide on the merged timeline
+(``python -m rocket_tpu.observe.trace <dir>``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+# Event layout (plain tuples — cheapest thing CPython can append):
+#   (kind, name, ts_ns, dur_ns, tid, fields)
+# kind: 'X' completed span, 'C' counter sample, 'I' instant / log event,
+#       'H' health transition.  ts_ns is perf_counter_ns at event start.
+SPAN = "X"
+COUNTER = "C"
+INSTANT = "I"
+HEALTH = "H"
+
+
+def _process_index() -> int:
+    """Best-effort process index for dump labeling — never touched on the
+    recording hot path, and never allowed to fail a dump."""
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disarmed-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NullSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def add(self, **fields: Any) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """One live span: stamps start at ``__enter__``, appends a completed
+    'X' event at ``__exit__``.  An exception escaping the body is recorded
+    in the span's fields (the flight recorder's most useful breadcrumb)."""
+
+    __slots__ = ("_buf", "_name", "_fields", "_t0")
+
+    def __init__(self, buf: deque, name: str, fields: Dict[str, Any]) -> None:
+        self._buf = buf
+        self._name = name
+        self._fields = fields
+
+    def __enter__(self) -> "_Span":
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type: Any, exc: Any, tb: Any) -> bool:
+        end = time.perf_counter_ns()
+        if exc_type is not None:
+            self._fields["error"] = repr(exc)
+        self._buf.append(
+            (SPAN, self._name, self._t0, end - self._t0,
+             threading.get_ident(), self._fields)
+        )
+        return False
+
+    def add(self, **fields: Any) -> None:
+        """Attach fields discovered mid-span (e.g. ``tripped=True``)."""
+        self._fields.update(fields)
+
+
+class Tracer:
+    """Per-process ring buffer of typed trace events.
+
+    Thread-safety: all mutation is a single ``deque.append`` (atomic under
+    the GIL); snapshots (:meth:`events`) take a point-in-time ``list()`` of
+    the deque, which is likewise safe against concurrent appends.
+    """
+
+    def __init__(self, capacity: int = 4096, enabled: bool = False) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._buf: deque = deque(maxlen=self.capacity)
+        self.enabled = bool(enabled)
+        # (wall seconds, perf_counter_ns) stamped at the launch barrier —
+        # the cross-host alignment point for merge_traces.
+        self.anchor: Optional[Tuple[float, int]] = None
+
+    # -- recording (hot path) -------------------------------------------
+
+    def span(self, name: str, **fields: Any):
+        """Context manager timing a code region.  Disabled tracers return
+        a shared no-op — callers never branch on ``enabled`` themselves."""
+        if not self.enabled:
+            return _NULL_SPAN
+        return _Span(self._buf, name, fields)
+
+    def counter(self, name: str, value: float, **fields: Any) -> None:
+        if not self.enabled:
+            return
+        fields[name.rsplit("/", 1)[-1]] = float(value)
+        self._buf.append(
+            (COUNTER, name, time.perf_counter_ns(), 0,
+             threading.get_ident(), fields)
+        )
+
+    def instant(self, name: str, **fields: Any) -> None:
+        if not self.enabled:
+            return
+        self._buf.append(
+            (INSTANT, name, time.perf_counter_ns(), 0,
+             threading.get_ident(), fields)
+        )
+
+    def health(self, name: str, state: str, **fields: Any) -> None:
+        """Health-state transition (serve SERVING/DEGRADED/DRAINING)."""
+        if not self.enabled:
+            return
+        fields["state"] = state
+        self._buf.append(
+            (HEALTH, name, time.perf_counter_ns(), 0,
+             threading.get_ident(), fields)
+        )
+
+    # -- control --------------------------------------------------------
+
+    def set_anchor(self) -> Tuple[float, int]:
+        """Stamp the cross-host alignment point.  Call IMMEDIATELY after a
+        barrier so every host anchors the same instant (up to skew)."""
+        self.anchor = (time.time(), time.perf_counter_ns())
+        return self.anchor
+
+    def resize(self, capacity: int) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self._buf = deque(self._buf, maxlen=self.capacity)
+
+    def clear(self) -> None:
+        self._buf.clear()
+
+    # -- inspection / export -------------------------------------------
+
+    def events(self) -> List[tuple]:
+        """Point-in-time snapshot of the ring (oldest first)."""
+        return list(self._buf)
+
+    def to_chrome(self) -> Dict[str, Any]:
+        """Export the ring as a Chrome-trace (catapult) document —
+        loadable in Perfetto / ``chrome://tracing``.  Timestamps are
+        microseconds of ``perf_counter``; :func:`merge_traces` rebases
+        them onto a shared cross-host origin."""
+        pid = _process_index()
+        out: List[Dict[str, Any]] = []
+        for kind, name, ts_ns, dur_ns, tid, fields in self.events():
+            ev: Dict[str, Any] = {
+                "name": name, "pid": pid, "tid": tid, "ts": ts_ns / 1e3,
+            }
+            if kind == SPAN:
+                ev["ph"] = "X"
+                ev["dur"] = dur_ns / 1e3
+                ev["args"] = fields
+            elif kind == COUNTER:
+                ev["ph"] = "C"
+                ev["args"] = fields
+            elif kind == HEALTH:
+                ev["ph"] = "i"
+                ev["s"] = "p"  # process-scoped marker line
+                ev["cat"] = "health"
+                ev["args"] = fields
+            else:  # INSTANT
+                ev["ph"] = "i"
+                ev["s"] = "t"
+                ev["args"] = fields
+            out.append(ev)
+        meta: Dict[str, Any] = {
+            "process_index": pid,
+            "capacity": self.capacity,
+            "clock": "perf_counter_ns/1e3 (us)",
+        }
+        if self.anchor is not None:
+            meta["anchor_wall_s"] = self.anchor[0]
+            meta["anchor_perf_us"] = self.anchor[1] / 1e3
+        return {
+            "traceEvents": out,
+            "displayTimeUnit": "ms",
+            "metadata": meta,
+        }
+
+    def dump_json(self, path: str) -> str:
+        doc = self.to_chrome()
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            # default=str: span fields are arbitrary user values (rids,
+            # enums) — a dump must never fail on an unserializable field.
+            json.dump(doc, f, default=str)
+        return path
+
+    def tail_text(self, n: int = 48) -> str:
+        """Human-readable last-``n`` events, newest last — the part of a
+        flight-recorder dump you read before opening Perfetto."""
+        lines = []
+        for kind, name, ts_ns, dur_ns, tid, fields in self.events()[-n:]:
+            stamp = f"{ts_ns / 1e9:14.6f}s"
+            if kind == SPAN:
+                body = f"span  {name}  {dur_ns / 1e6:9.3f}ms"
+            elif kind == COUNTER:
+                body = f"count {name}"
+            elif kind == HEALTH:
+                body = f"health {name} -> {fields.get('state')}"
+            else:
+                body = f"event {name}"
+            extras = {k: v for k, v in fields.items() if k != "state"}
+            suffix = f"  {extras}" if extras else ""
+            lines.append(f"{stamp}  tid={tid}  {body}{suffix}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- module-global tracer (what runtime.tracing arms) -----------------------
+
+_GLOBAL = Tracer()
+
+
+def get_tracer() -> Tracer:
+    """The process-wide tracer instrumented code records into."""
+    return _GLOBAL
+
+
+def arm(capacity: Optional[int] = None) -> Tracer:
+    """Enable the global tracer (idempotent; optionally resize)."""
+    if capacity is not None and capacity != _GLOBAL.capacity:
+        _GLOBAL.resize(capacity)
+    _GLOBAL.enabled = True
+    return _GLOBAL
+
+
+def disarm() -> Tracer:
+    _GLOBAL.enabled = False
+    return _GLOBAL
+
+
+def span(name: str, **fields: Any):
+    """``with trace.span("phase", key=val): ...`` on the global tracer."""
+    return _GLOBAL.span(name, **fields)
+
+
+# -- latency histograms -----------------------------------------------------
+
+
+class Histogram:
+    """Bounded reservoir of float samples with nearest-rank percentiles.
+
+    ``capacity`` bounds memory like the event ring does: long-running
+    serve loops keep a sliding window of recent latencies, which is what
+    an operator wants from ``trace/*`` scalars anyway.  ``count`` is
+    lifetime-total (not window-bounded)."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self._samples: deque = deque(maxlen=int(capacity))
+        self.count = 0
+
+    def record(self, value: float) -> None:
+        self._samples.append(float(value))
+        self.count += 1
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def percentile(self, q: float) -> Optional[float]:
+        """Nearest-rank percentile over the current window; ``None`` when
+        empty (callers emit nothing rather than a fake zero)."""
+        if not self._samples:
+            return None
+        ordered = sorted(self._samples)
+        idx = int(round((q / 100.0) * (len(ordered) - 1)))
+        return ordered[max(0, min(len(ordered) - 1, idx))]
+
+    def summary(self, prefix: str) -> Dict[str, float]:
+        """p50/p95/p99 + count, keyed ``<prefix>/p50`` etc.; empty dict
+        when no samples yet."""
+        if not self._samples:
+            return {}
+        return {
+            f"{prefix}/p50": self.percentile(50),
+            f"{prefix}/p95": self.percentile(95),
+            f"{prefix}/p99": self.percentile(99),
+            f"{prefix}/count": float(self.count),
+        }
+
+
+# -- multi-host merge --------------------------------------------------------
+
+
+def _iter_trace_files(trace_dir: str) -> Iterable[str]:
+    for root, _dirs, files in os.walk(trace_dir):
+        for name in sorted(files):
+            if name.endswith(".json"):
+                yield os.path.join(root, name)
+
+
+def merge_traces(
+    trace_dir: str, out_path: Optional[str] = None
+) -> Dict[str, Any]:
+    """Merge every per-host Chrome-trace dump under ``trace_dir`` into one
+    aligned timeline.
+
+    Alignment: host ``h``'s events carry that host's ``perf_counter``
+    microseconds; its metadata carries the anchor pair stamped at the
+    launch barrier.  On the merged timeline an event lands at::
+
+        (ts - anchor_perf_us[h]) + (anchor_wall_s[h] - min_wall) * 1e6
+
+    i.e. microseconds since the earliest host's barrier instant, so the
+    barrier skew between hosts is the only residual error.  Dumps without
+    an anchor (tracing armed outside a Launcher) are kept on their raw
+    clock and flagged in the merged metadata.  Events get
+    ``pid = process_index`` so Perfetto shows one lane group per host.
+    """
+    docs: List[Tuple[str, Dict[str, Any]]] = []
+    for path in _iter_trace_files(trace_dir):
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list):
+            docs.append((path, doc))
+    if not docs:
+        raise FileNotFoundError(
+            f"no Chrome-trace JSON dumps found under {trace_dir!r}"
+        )
+    anchored = [
+        d for _p, d in docs
+        if d.get("metadata", {}).get("anchor_wall_s") is not None
+    ]
+    min_wall = min(
+        (d["metadata"]["anchor_wall_s"] for d in anchored), default=None
+    )
+    merged: List[Dict[str, Any]] = []
+    unanchored = []
+    for path, doc in docs:
+        meta = doc.get("metadata", {})
+        pid = int(meta.get("process_index", 0))
+        wall = meta.get("anchor_wall_s")
+        perf_us = meta.get("anchor_perf_us")
+        if wall is None or perf_us is None or min_wall is None:
+            shift = 0.0
+            unanchored.append(os.path.basename(path))
+        else:
+            shift = (wall - min_wall) * 1e6 - perf_us
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = pid
+            ev["ts"] = float(ev.get("ts", 0.0)) + shift
+            merged.append(ev)
+    merged.sort(key=lambda ev: ev["ts"])
+    out: Dict[str, Any] = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "merged_from": len(docs),
+            "hosts": sorted(
+                {int(d.get("metadata", {}).get("process_index", 0))
+                 for _p, d in docs}
+            ),
+            "unanchored_files": unanchored,
+        },
+    }
+    if out_path is not None:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(out, f, default=str)
+    return out
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rocket_tpu.observe.trace",
+        description="Merge per-host flight-recorder dumps into one "
+        "Perfetto-loadable timeline aligned at the launch barrier.",
+    )
+    parser.add_argument("trace_dir", help="directory of per-host dumps "
+                        "(e.g. <project>/logs/flightrec)")
+    parser.add_argument(
+        "-o", "--out", default=None,
+        help="output path (default: <trace_dir>/merged.json)",
+    )
+    args = parser.parse_args(argv)
+    out_path = args.out or os.path.join(args.trace_dir, "merged.json")
+    doc = merge_traces(args.trace_dir, out_path)
+    print(
+        f"merged {doc['metadata']['merged_from']} dump(s) from hosts "
+        f"{doc['metadata']['hosts']} -> {out_path} "
+        f"({len(doc['traceEvents'])} events)"
+    )
+    if doc["metadata"]["unanchored_files"]:
+        print(
+            "warning: unanchored (raw-clock) dumps: "
+            + ", ".join(doc["metadata"]["unanchored_files"])
+        )
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    raise SystemExit(_main())

@@ -122,8 +122,10 @@ def scalar_sink(
     (the serving robustness layer flushes its ``serve/*`` counters here).
     Resolves the same backend specs the :class:`Tracker` capsule accepts
     (``"jsonl"``, ``"memory"``, a :class:`TrackerBackend` instance, a
-    list) without needing a runtime registry; the caller owns the handle
-    and must ``close()`` it."""
+    list) without needing a runtime registry.  The caller owns the
+    handle: ``close()`` it, or use it as a context manager —
+    ``with scalar_sink("jsonl", dir) as sink: ...`` closes on exit
+    (ISSUE 4 satellite)."""
     return resolve_backend(backend, logging_dir)
 
 

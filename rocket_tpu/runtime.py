@@ -51,6 +51,8 @@ class Runtime:
         gradient_accumulation_steps: int = 1,
         rules: ShardingRules = DEFAULT_RULES,
         seed: int = 0,
+        tracing: bool = False,
+        trace_capacity: int = 4096,
     ) -> None:
         if mesh is None:
             mesh = data_parallel_mesh()
@@ -67,6 +69,15 @@ class Runtime:
         self.gradient_accumulation_steps = int(gradient_accumulation_steps)
         self.rules = rules
         self.seed = int(seed)
+        # Host-side structured tracing (observe.trace): arming here turns
+        # on the Dispatcher's per-capsule lifecycle spans, the serve loop's
+        # per-request spans, and the Launcher's flight-recorder install.
+        # Lazy import — observe pulls in core capsules, runtime must not.
+        self.tracing = bool(tracing)
+        if self.tracing:
+            from rocket_tpu.observe.trace import arm
+
+            arm(trace_capacity)
 
         self._checkpointables: List[Any] = []
         self._ckpt_counter = 0
@@ -121,6 +132,14 @@ class Runtime:
 
     def wait_for_everyone(self, tag: str = "barrier") -> None:
         multihost.sync_global_devices(tag)
+
+    @property
+    def tracer(self):
+        """The process-global :class:`~rocket_tpu.observe.trace.Tracer`
+        (enabled iff ``tracing`` armed it — or someone armed it directly)."""
+        from rocket_tpu.observe.trace import get_tracer
+
+        return get_tracer()
 
     def request_stop(self, reason: str = "") -> None:
         """Vote to end the run at the next epoch boundary (preemption,

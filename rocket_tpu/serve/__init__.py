@@ -6,7 +6,7 @@ reliability") for the operator view.
 """
 
 from rocket_tpu.serve.loop import ServingLoop
-from rocket_tpu.serve.metrics import ServeCounters
+from rocket_tpu.serve.metrics import ServeCounters, ServeLatency
 from rocket_tpu.serve.policy import (
     DEFAULT_LADDER,
     DegradationLevel,
@@ -38,5 +38,6 @@ __all__ = [
     "Request",
     "Result",
     "ServeCounters",
+    "ServeLatency",
     "ServingLoop",
 ]

@@ -46,7 +46,9 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from rocket_tpu.serve.metrics import ServeCounters
+from rocket_tpu.observe.recorder import active_recorder
+from rocket_tpu.observe.trace import get_tracer
+from rocket_tpu.serve.metrics import ServeCounters, ServeLatency
 from rocket_tpu.serve.policy import DegradationPolicy
 from rocket_tpu.serve.queue import AdmissionQueue
 from rocket_tpu.serve.types import (
@@ -65,13 +67,21 @@ LOG = logging.getLogger("rocket_tpu.serve")
 class _Row:
     """Host-side bookkeeping for one occupied batcher row."""
 
-    __slots__ = ("req", "admitted_at", "prompt_len", "budget",
-                 "requested", "demoted", "rounds_seen")
+    __slots__ = ("req", "admitted_at", "submitted_at", "first_tok_at",
+                 "prompt_len", "budget", "requested", "demoted",
+                 "rounds_seen")
 
     def __init__(self, req: Request, admitted_at: float, prompt_len: int,
-                 budget: int, requested: int, demoted: bool) -> None:
+                 budget: int, requested: int, demoted: bool,
+                 submitted_at: Optional[float] = None) -> None:
         self.req = req
         self.admitted_at = admitted_at
+        # submit() stamps the request; direct-admitted requests (tests)
+        # fall back to admission time so latencies stay well-defined.
+        self.submitted_at = (
+            submitted_at if submitted_at is not None else admitted_at
+        )
+        self.first_tok_at: Optional[float] = None  # TTFT instant
         self.prompt_len = prompt_len
         self.budget = budget          # new-token cap actually enforced
         self.requested = requested    # what the caller asked for
@@ -115,6 +125,8 @@ class ServingLoop:
         sink: Optional[Any] = None,
         flush_every: int = 8,
         recover_rounds: int = 4,
+        tracer: Optional[Any] = None,
+        recorder: Optional[Any] = None,
         logger: Optional[logging.Logger] = None,
     ) -> None:
         if max_batch < 1:
@@ -130,6 +142,15 @@ class ServingLoop:
         self._sink = sink
         self._flush_every = int(flush_every)
         self._recover_rounds = int(recover_rounds)
+        # Tracing (ISSUE 4): spans/instants go to the process tracer (a
+        # no-op unless armed); latency histograms fill regardless (host
+        # floats only — no device syncs) and flush as ``trace/*`` scalars.
+        # ``recorder`` overrides the process-global flight recorder for
+        # crash dumps on trips/step errors.
+        self._tracer = tracer if tracer is not None else get_tracer()
+        self._recorder = recorder
+        self.latency = ServeLatency()
+        self._last_health = HealthState.SERVING
         self._log = logger if logger is not None else LOG
 
         self._rows: Dict[int, Optional[_Row]] = {
@@ -173,6 +194,19 @@ class ServingLoop:
     def drain(self) -> None:
         """Stop admitting new work; queued + in-flight requests finish."""
         self._draining = True
+        self._observe_health()
+
+    def _observe_health(self) -> None:
+        """Record health-state transitions as typed tracer events — the
+        flight recorder's timeline then shows WHEN the loop degraded,
+        not just that it did."""
+        state = self.health
+        if state is not self._last_health:
+            self._tracer.health(
+                "serve/health", state.value, prev=self._last_health.value,
+                level=self.policy.level, queue_depth=len(self.queue),
+            )
+            self._last_health = state
 
     def close(self) -> None:
         self._flush(force=True)
@@ -186,6 +220,11 @@ class ServingLoop:
         :meth:`drain_results`) when the queue is full or the loop is
         draining — admission control answers IMMEDIATELY."""
         self.counters.submitted += 1
+        # Queue-wait / TTFT / e2e all measure from this stamp (the loop
+        # clock, so fake-clock tests stay deterministic).  Request is a
+        # plain dataclass — the private stamp rides the object.
+        req._submit_ts = self._clock()
+        self._tracer.instant("serve/submit", rid=req.rid)
         if self._draining:
             rej = Overloaded(req.rid, self._clock(), reason="draining")
         elif not self.queue.offer(req):
@@ -193,6 +232,8 @@ class ServingLoop:
         else:
             return None
         self.counters.shed_overload += 1
+        self._tracer.instant("serve/overloaded", rid=req.rid,
+                             reason=rej.reason)
         self._results.append(rej)
         return rej
 
@@ -222,6 +263,7 @@ class ServingLoop:
             if self._recover_in > 0:
                 self._recover_in -= 1
         self._update_policy()
+        self._observe_health()
         self._flush()
         return True
 
@@ -291,9 +333,18 @@ class ServingLoop:
         demoted = bool(req.beam)
         if demoted:
             self.counters.beam_demoted += 1
-        self._bat.admit(row, prompt[None, :])
+        submitted = getattr(req, "_submit_ts", None)
+        wait_ms = (now - submitted) * 1e3 if submitted is not None else 0.0
+        self.latency.queue_wait_ms.record(wait_ms)
+        # The admit IS the row's prefill (the batcher rebuilds the row's
+        # cache from the prompt) — one span covers admission + prefill.
+        with self._tracer.span(
+            "serve/admit", rid=req.rid, row=row,
+            prompt_len=int(prompt.shape[0]), queue_wait_ms=wait_ms,
+        ):
+            self._bat.admit(row, prompt[None, :])
         self._rows[row] = _Row(req, now, prompt.shape[0], budget,
-                               requested, demoted)
+                               requested, demoted, submitted_at=submitted)
         self.counters.admitted += 1
 
     def _serve_beam(self, req: Request, now: float) -> None:
@@ -301,13 +352,19 @@ class ServingLoop:
         not a batcher row).  Under pressure the ladder flips
         ``beam=False`` and these requests demote to the greedy lane."""
         budget, _ = self._budget(req, req.prompt.shape[0])
-        toks = np.asarray(self._beam_fn(req.prompt[None, :], budget))
+        with self._tracer.span("serve/beam", rid=req.rid,
+                               prompt_len=int(req.prompt.shape[0])):
+            toks = np.asarray(self._beam_fn(req.prompt[None, :], budget))
         toks = toks[0] if toks.ndim == 2 else toks
         self.counters.admitted += 1
         self.counters.beam_served += 1
         self.counters.completed += 1
+        done = self._clock()
+        submitted = getattr(req, "_submit_ts", now)
+        self.latency.queue_wait_ms.record((now - submitted) * 1e3)
+        self.latency.e2e_ms.record((done - submitted) * 1e3)
         self._results.append(Completed(
-            req.rid, self._clock(), tokens=toks, n_tok=int(toks.shape[0]),
+            req.rid, done, tokens=toks, n_tok=int(toks.shape[0]),
             via_beam=True,
         ))
 
@@ -323,16 +380,29 @@ class ServingLoop:
             return np.asarray(bat.state[0]), n_tok, done
 
         t0 = time.monotonic()
+        # The per-round decode span: it CLOSES when the with-block exits
+        # (trip, exception, or success alike), so by the time a failure
+        # path dumps the flight recorder, the stuck round's span is
+        # already the last thing in the ring (ISSUE 4 acceptance).
+        round_span = self._tracer.span(
+            "serve/round", round=self.counters.rounds + 1,
+            n_draft=n_draft, live=len(self._live_rows()),
+        )
         try:
-            if n_draft not in self._compiled_drafts:
-                # first build of this variant: compile inline, unwatched
-                ok, value = True, _step()
-                self._compiled_drafts.add(n_draft)
-            else:
-                ok, value = self.watchdog.run(_step)
+            with round_span:
+                if n_draft not in self._compiled_drafts:
+                    # first build of this variant: compile inline, unwatched
+                    round_span.add(compile=True)
+                    ok, value = True, _step()
+                    self._compiled_drafts.add(n_draft)
+                else:
+                    ok, value = self.watchdog.run(_step)
+                if not ok:
+                    round_span.add(tripped=True)
         except Exception as exc:  # step raised on worker/caller thread
             self._log.warning("serve: step failed: %r", exc)
-            self._fail_inflight(f"step error: {exc!r}")
+            dump = self._dump_flight("step-error")
+            self._fail_inflight(f"step error: {exc!r}", dump_path=dump)
             self._rebuild()
             return False
         if not ok:
@@ -341,7 +411,9 @@ class ServingLoop:
                 self.watchdog.timeout,
             )
             self.counters.watchdog_trips += 1
-            self._fail_inflight("watchdog: stuck device step")
+            dump = self._dump_flight("watchdog-trip")
+            self._fail_inflight("watchdog: stuck device step",
+                                dump_path=dump)
             self._rebuild()
             return False
 
@@ -350,10 +422,32 @@ class ServingLoop:
         round_ms = (time.monotonic() - t0) * 1e3
         self.counters.observe_round_ms(round_ms)
         self._round_ms = self.counters.round_ms_ema
+        now = self._clock()
         for occ in self._rows.values():
             if occ is not None:
                 occ.rounds_seen += 1
+                if occ.rounds_seen == 1:
+                    # first harvested round containing this row's first
+                    # generated token — the TTFT instant
+                    occ.first_tok_at = now
+                    self.latency.ttft_ms.record(
+                        (now - occ.submitted_at) * 1e3
+                    )
         return True
+
+    def _dump_flight(self, reason: str) -> Optional[str]:
+        """Write a flight-recorder dump (loop-local recorder if given,
+        else the process-global one); ``None`` when neither is armed.
+        Never raises — the recovery path must run regardless."""
+        rec = self._recorder if self._recorder is not None \
+            else active_recorder()
+        if rec is None:
+            return None
+        try:
+            return rec.dump(reason)
+        except Exception:
+            self._log.warning("serve: flight dump failed", exc_info=True)
+            return None
 
     def _partial(self, row: int, occ: _Row) -> Tuple[Optional[np.ndarray],
                                                      int]:
@@ -366,15 +460,19 @@ class ServingLoop:
         n = int(n_tok[row])
         return np.asarray(buf[row][:n]), n
 
-    def _fail_inflight(self, reason: str) -> None:
+    def _fail_inflight(self, reason: str,
+                       dump_path: Optional[str] = None) -> None:
         now = self._clock()
         for row, occ in self._rows.items():
             if occ is None:
                 continue
             toks, n = self._partial(row, occ)
             self.counters.failed += 1
+            self._tracer.instant("serve/failed", rid=occ.req.rid,
+                                 row=row, reason=reason)
             self._results.append(Failed(
                 occ.req.rid, now, tokens=toks, n_tok=n, reason=reason,
+                dump_path=dump_path,
             ))
             self._rows[row] = None
 
@@ -403,6 +501,7 @@ class ServingLoop:
             if bool(done_h[row]):
                 toks, nt = self._bat.row_tokens(row)
                 self.counters.completed += 1
+                self._finish_latency(occ, now, nt, "serve/complete", row)
                 self._results.append(Completed(
                     occ.req.rid, now, tokens=toks, n_tok=nt,
                     beam_demoted=occ.demoted,
@@ -412,6 +511,7 @@ class ServingLoop:
                 toks, nt = self._bat.row_tokens(row)
                 self._bat.retire(row)
                 self.counters.evicted_deadline += 1
+                self._finish_latency(occ, now, n, "serve/evict", row)
                 self._results.append(DeadlineExceeded(
                     occ.req.rid, now, tokens=toks[:n], n_tok=n,
                     stage="decode",
@@ -424,11 +524,25 @@ class ServingLoop:
                 if truncated:
                     self.counters.truncated += 1
                 self.counters.completed += 1
+                self._finish_latency(occ, now, nt, "serve/complete", row)
                 self._results.append(Completed(
                     occ.req.rid, now, tokens=toks, n_tok=nt,
                     truncated=truncated, beam_demoted=occ.demoted,
                 ))
                 self._rows[row] = None
+
+    def _finish_latency(self, occ: _Row, now: float, n_tok: int,
+                        event: str, row: int) -> None:
+        """Terminal accounting for one row: e2e always; TPOT when at
+        least two generated tokens bracket an interval."""
+        self.latency.e2e_ms.record((now - occ.submitted_at) * 1e3)
+        produced = n_tok - occ.prompt_len
+        if occ.first_tok_at is not None and produced > 1:
+            self.latency.tpot_ms.record(
+                (now - occ.first_tok_at) * 1e3 / (produced - 1)
+            )
+        self._tracer.instant(event, rid=occ.req.rid, row=row,
+                             n_tok=n_tok, rounds=occ.rounds_seen)
 
     def _update_policy(self) -> None:
         before = self.policy.level
@@ -448,4 +562,9 @@ class ServingLoop:
             data = {
                 f"serve/{k}": v for k, v in self.counters.snapshot().items()
             }
+            # Request-level latency percentiles ride the same flush as
+            # ``trace/*`` scalars (ISSUE 4: TTFT/TPOT/e2e p50/p95/p99).
+            data.update({
+                f"trace/{k}": v for k, v in self.latency.summary().items()
+            })
             self._sink.log_scalars(data, step=self.counters.rounds)

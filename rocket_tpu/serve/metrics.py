@@ -11,6 +11,8 @@ from __future__ import annotations
 
 from typing import Dict
 
+from rocket_tpu.observe.trace import Histogram
+
 
 class ServeCounters:
     """Plain integer counters plus the round-latency EMA.  ``snapshot``
@@ -64,3 +66,31 @@ class ServeCounters:
             "degrade_peak": float(self.degrade_peak),
             "round_ms_ema": float(self.round_ms_ema),
         }
+
+
+class ServeLatency:
+    """Request-level latency histograms, all in milliseconds on the serve
+    loop's injected clock (so fake-clock tests are deterministic):
+
+    - ``queue_wait_ms`` — submit → batcher admission (prefill start);
+    - ``ttft_ms`` — submit → the first harvested round that contained the
+      request's first generated token (time-to-first-token);
+    - ``tpot_ms`` — mean per-token interval AFTER the first token
+      (time-per-output-token), recorded once at request completion;
+    - ``e2e_ms`` — submit → the typed terminal result.
+
+    :meth:`summary` flattens to ``<name>/p50|p95|p99|count`` floats —
+    the serve loop prefixes them ``trace/`` and flushes them through the
+    same tracker backend as the ``serve/*`` counters."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self.queue_wait_ms = Histogram(capacity)
+        self.ttft_ms = Histogram(capacity)
+        self.tpot_ms = Histogram(capacity)
+        self.e2e_ms = Histogram(capacity)
+
+    def summary(self) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for name in ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
+            out.update(getattr(self, name).summary(name))
+        return out

@@ -115,6 +115,11 @@ class DeadlineExceeded(Result):
 
 @dataclasses.dataclass(frozen=True)
 class Failed(Result):
+    """``dump_path`` points at the flight-recorder dump written when the
+    failure was detected (``None`` when no recorder was armed) — the
+    caller's ticket attaches the exact host-side timeline of the trip."""
+
     tokens: Optional[np.ndarray] = None
     n_tok: int = 0
     reason: str = "step failure"
+    dump_path: Optional[str] = None

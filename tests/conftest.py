@@ -39,6 +39,11 @@ def pytest_configure(config):
         "serving: serving-robustness tests (rocket_tpu.serve — deadlines, "
         "backpressure, watchdog recovery; see docs/reliability.md)",
     )
+    config.addinivalue_line(
+        "markers",
+        "tracing: structured-tracing / flight-recorder tests "
+        "(rocket_tpu.observe.trace|recorder; see docs/observability.md)",
+    )
 
 
 @pytest.fixture(scope="session")

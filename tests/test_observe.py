@@ -8,7 +8,13 @@ import numpy as np
 import pytest
 
 import rocket_tpu as rt
-from rocket_tpu.observe import JsonlBackend, MemoryBackend, Throughput
+from rocket_tpu.observe import (
+    JsonlBackend,
+    MemoryBackend,
+    Profiler,
+    Throughput,
+    scalar_sink,
+)
 from rocket_tpu.observe.backends import resolve_backend
 
 
@@ -249,19 +255,174 @@ class TestInStepMeter:
 
 
 class TestThroughput:
-    def test_rate_published_to_loop_state(self):
-        tp = Throughput(ema=0.0, log_every=2)
-        attrs = rt.Attributes(
+    def _attrs(self):
+        return rt.Attributes(
             batch={"x": np.zeros((16, 2))},
             looper=rt.Attributes(state=rt.Attributes()),
             tracker=rt.Attributes(scalars=[], images=[]),
         )
+
+    def test_rate_published_to_loop_state(self):
+        tp = Throughput(ema=0.0, log_every=2)
+        attrs = self._attrs()
         tp.set(attrs)
         for _ in range(4):
             tp.launch(attrs)
         assert "throughput" in attrs.looper.state
         tags = [t for rec in attrs.tracker.scalars for t in rec.data]
         assert "throughput/samples_per_sec" in tags
+
+    def test_set_realigns_log_every_cadence(self):
+        """ISSUE 4 satellite: ``set`` must reset the within-cycle counter
+        — a leftover ``_iter`` skewed every later cycle's record cadence
+        (the first launch after ``set`` only primes the clock)."""
+        tp = Throughput(ema=0.0, log_every=3)
+        attrs = self._attrs()
+        tp.set(attrs)
+        for _ in range(4):  # prime + 3 counted -> one record
+            tp.launch(attrs)
+        assert len(attrs.tracker.scalars) == 1
+        tp.set(attrs)       # new cycle: cadence restarts from zero
+        for _ in range(3):  # prime + 2 counted -> nothing yet
+            tp.launch(attrs)
+        assert len(attrs.tracker.scalars) == 1
+        tp.launch(attrs)    # third counted iteration of THIS cycle
+        assert len(attrs.tracker.scalars) == 2
+
+    def test_reset_flushes_final_subwindow_reading(self):
+        """ISSUE 4 satellite: a cycle shorter than ``log_every`` still
+        produces one throughput scalar at cycle end — and re-resetting
+        must not double-flush it."""
+        tp = Throughput(ema=0.0, log_every=50)
+        attrs = self._attrs()
+        tp.set(attrs)
+        for _ in range(3):
+            tp.launch(attrs)
+        assert attrs.tracker.scalars == []
+        tp.reset(attrs)
+        assert len(attrs.tracker.scalars) == 1
+        assert "throughput/samples_per_sec" in attrs.tracker.scalars[0].data
+        tp.reset(attrs)  # nothing pending -> no duplicate record
+        assert len(attrs.tracker.scalars) == 1
+
+    def test_record_steps_monotonic_across_cycles(self):
+        """Records carry the never-resetting global iteration as their
+        step, so a later cycle's scalars never overwrite an earlier
+        cycle's in last-write-wins backends."""
+        tp = Throughput(ema=0.0, log_every=2)
+        attrs = self._attrs()
+        for _ in range(2):
+            tp.set(attrs)
+            for _ in range(5):  # prime + 4 counted -> records at 2 and 4
+                tp.launch(attrs)
+            tp.reset(attrs)
+        steps = [int(rec.step) for rec in attrs.tracker.scalars]
+        assert steps == sorted(set(steps)), steps  # strictly increasing
+
+
+class TestProfiler:
+    def _calls(self, monkeypatch):
+        calls = []
+        import jax
+
+        monkeypatch.setattr(
+            jax.profiler, "start_trace", lambda d: calls.append("start")
+        )
+        monkeypatch.setattr(
+            jax.profiler, "stop_trace", lambda: calls.append("stop")
+        )
+        return calls
+
+    def test_window_captured_once_then_done(self, tmp_path, monkeypatch):
+        calls = self._calls(monkeypatch)
+        prof = Profiler(start=2, count=2, log_dir=str(tmp_path))
+        prof.bind(rt.Runtime())
+        for _ in range(8):
+            prof.launch()
+        assert calls == ["start", "stop"]
+        prof.destroy()  # _done: no double-stop
+        assert calls == ["start", "stop"]
+
+    def test_start_trace_failure_disables(self, tmp_path, monkeypatch):
+        """ISSUE 4 satellite: a failed ``start_trace`` (e.g. another
+        trace already active in the process) disables this Profiler
+        instead of re-raising every remaining iteration."""
+        import jax
+
+        calls = []
+
+        def boom(d):
+            calls.append("start")
+            raise RuntimeError("already tracing")
+
+        monkeypatch.setattr(jax.profiler, "start_trace", boom)
+        prof = Profiler(start=0, count=2, log_dir=str(tmp_path))
+        prof.bind(rt.Runtime())
+        prof.launch()           # fails, disables
+        prof.launch()           # must not retry
+        assert calls == ["start"]
+        assert prof._done and not prof._active
+
+    def test_non_main_process_skips_capture(self, tmp_path, monkeypatch):
+        """ISSUE 4 satellite: non-main processes never call start_trace —
+        they log the skip once and mark themselves done."""
+        calls = self._calls(monkeypatch)
+
+        class NotMain:
+            is_main_process = False
+            process_index = 3
+
+        prof = Profiler(start=0, count=2, log_dir=str(tmp_path))
+        prof.bind(NotMain())
+        for _ in range(3):
+            prof.launch()
+        assert calls == []
+        assert prof._done
+
+    def test_stop_trace_exception_leaves_clean_flags(
+        self, tmp_path, monkeypatch
+    ):
+        """ISSUE 4 satellite: a raising ``stop_trace`` must not leave
+        ``_active`` set — teardown would double-stop and mask the
+        original error."""
+        import jax
+
+        monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+
+        def boom():
+            raise RuntimeError("xplane writer died")
+
+        monkeypatch.setattr(jax.profiler, "stop_trace", boom)
+        prof = Profiler(start=0, count=1, log_dir=str(tmp_path))
+        prof.bind(rt.Runtime())
+        prof.launch()  # starts
+        with pytest.raises(RuntimeError, match="xplane"):
+            prof.launch()  # window over -> stop raises
+        assert prof._done and not prof._active
+        prof.destroy()  # early-returns; the error above stays the story
+
+
+class TestScalarSink:
+    def test_context_manager_closes_backend(self, tmp_path):
+        """ISSUE 4 satellite: ``scalar_sink`` handles work as context
+        managers, so serve loops / scripts can't leak a writer."""
+        with scalar_sink("jsonl", str(tmp_path)) as sink:
+            assert isinstance(sink, JsonlBackend)
+            sink.log_scalars({"serve/rounds": 1.0}, step=0)
+        assert sink._file.closed
+        line = json.loads(open(tmp_path / "metrics.jsonl").read().strip())
+        assert line["serve/rounds"] == 1.0
+
+    def test_exception_still_closes(self, tmp_path):
+        with pytest.raises(ValueError, match="boom"):
+            with scalar_sink("jsonl", str(tmp_path)) as sink:
+                raise ValueError("boom")
+        assert sink._file.closed
+
+    def test_memory_sink_roundtrip(self):
+        with scalar_sink("memory") as sink:
+            sink.log_scalars({"a": 2.0}, step=1)
+        assert sink.scalars == [(1, {"a": 2.0})]
 
 
 class TestPerplexity:
