@@ -341,13 +341,21 @@ def run_config(name, module, batch_np, samples_per_step, n_steps, warmup,
     jax.block_until_ready(module.state.params)
 
     t0 = time.perf_counter()
+    gaps = []
     for i in range(n_steps):
         attrs.batch = batches[i % len(batches)]
+        g0 = time.perf_counter()
         module.launch(attrs)  # state threads: step i+1 depends on step i
+        gaps.append(time.perf_counter() - g0)
     jax.block_until_ready(module.state.params)
     elapsed = time.perf_counter() - t0
 
     step_time = elapsed / n_steps
+    # Host dispatch gap: time the host spends enqueuing each step — the
+    # window the chip sits idle between back-to-back steps.  Median, so a
+    # one-off GC pause doesn't masquerade as a dispatch regression (the
+    # async-loop guard in tests/test_bench_guard.py holds this down).
+    dispatch_gap_ms = float(np.median(gaps)) * 1e3
     try:
         flops = flops_fn(module, batches[0])
     except Exception as exc:  # cost analysis unavailable on this backend
@@ -359,6 +367,7 @@ def run_config(name, module, batch_np, samples_per_step, n_steps, warmup,
         "value": round(samples_per_step / step_time, 1),
         "vs_baseline": round(mfu / 0.50, 3) if mfu else None,
         "step_time_ms": round(step_time * 1e3, 2),
+        "dispatch_gap_ms": round(dispatch_gap_ms, 3),
         "mfu": round(mfu, 4) if mfu else None,
         "device": jax.devices()[0].device_kind,
     }

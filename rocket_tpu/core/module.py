@@ -111,7 +111,7 @@ class Module(Dispatcher):
         input_spec: Optional[Any] = None,
         statefull: bool = True,
         priority: int = 1000,
-        donate: bool = True,
+        donate: Optional[bool] = None,
         eval_with_ema: bool = False,
         fuse_accumulation: bool = False,
         skip_nonfinite: Optional[bool] = None,
@@ -122,6 +122,12 @@ class Module(Dispatcher):
         )
         self._adapter = _as_adapter(model)
         self._input_spec = input_spec
+        # None = defer to runtime.donate_train_state (default True): the
+        # TrainState argument's buffers are donated to the jitted step, so
+        # XLA reuses them for the output state instead of holding both
+        # alive.  Pass False explicitly (or Runtime(donate_train_state=
+        # False)) as the escape hatch when the OLD state must outlive a
+        # step — e.g. custom capsules diffing consecutive states.
         self._donate = donate
         self._eval_with_ema = eval_with_ema
         self._fuse_accum = fuse_accumulation
@@ -420,6 +426,19 @@ class Module(Dispatcher):
             if self._skip_nonfinite is not None
             else bool(getattr(self._runtime, "skip_nonfinite_updates", False))
         )
+        donate = (
+            self._donate
+            if self._donate is not None
+            else bool(getattr(self._runtime, "donate_train_state", True))
+        )
+        self._donate = donate  # resolved: later rebuilds stay consistent
+        # Capability gate, applied at the jit edge (the resolved intent
+        # above is what rebuilds and user code see): XLA's CPU client does
+        # not implement buffer donation — it warns and ignores the aliasing
+        # — but a call with donated operands still dispatches
+        # SYNCHRONOUSLY, which would serialize the non-blocking loop's
+        # in-flight window for zero memory benefit.
+        donate = donate and jax.default_backend() != "cpu"
         if self._tx is not None:
             if self._use_window:
                 if skip:
@@ -434,7 +453,7 @@ class Module(Dispatcher):
                         self._tx,
                         policy=policy,
                         window=self._accum,
-                        donate=self._donate,
+                        donate=donate,
                     )
                 }
             else:
@@ -444,7 +463,7 @@ class Module(Dispatcher):
                     self._tx,
                     policy=policy,
                     gradient_accumulation_steps=self._accum,
-                    donate=self._donate,
+                    donate=donate,
                     skip_nonfinite=skip,
                 )
         self._eval_step = build_eval_step(

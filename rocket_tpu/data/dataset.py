@@ -41,6 +41,7 @@ class Dataset(Capsule):
         drop_last: bool = False,
         collate_fn: Optional[Callable] = None,
         prefetch: int = 2,
+        device_prefetch: int = 1,
         shuffle_buffer: int = 1024,
         num_workers: int = 0,
         loader: Optional[DataLoader] = None,
@@ -60,6 +61,7 @@ class Dataset(Capsule):
             drop_last=drop_last,
             collate_fn=collate_fn,
             prefetch=prefetch,
+            device_prefetch=device_prefetch,
             shuffle_buffer=shuffle_buffer,
             num_workers=num_workers,
         )

@@ -114,9 +114,20 @@ class DataLoader:
         through as lists — reference ``torch_collate`` semantics).
     sharding:
         ``jax.sharding.NamedSharding`` for the batch's leading dim (from
-        ``runtime.batch_sharding()``). ``None`` keeps batches on host.
+        ``runtime.batch_sharding()``). ``None`` resolves the active
+        :func:`~rocket_tpu.parallel.context.mesh_context` mesh per epoch
+        (data-axis batch spec); with no mesh active either, batches stay
+        on host.
     prefetch:
-        Number of batches staged ahead (0 disables the background thread).
+        Number of HOST batches staged ahead by the background thread
+        (0 disables the thread).
+    device_prefetch:
+        Depth of the device-transfer stage: ``jax.device_put`` /
+        global-array assembly for the NEXT ``device_prefetch`` batches is
+        issued before the current batch is consumed, so H2D rides under
+        the step that is still computing (JAX transfers are async — issuing
+        early costs nothing on the host).  ``0`` recovers the synchronous
+        transfer-on-demand behavior.
     num_workers:
         Map-style sources only: fork this many worker PROCESSES that
         fetch + collate batches in parallel (the reference's torch
@@ -136,6 +147,7 @@ class DataLoader:
         collate_fn: Optional[Callable] = None,
         sharding: Optional[Any] = None,
         prefetch: int = 2,
+        device_prefetch: int = 1,
         mask_key: str = "_valid",
         shuffle_buffer: int = 1024,
         num_workers: int = 0,
@@ -151,6 +163,9 @@ class DataLoader:
         self.collate_fn = collate_fn or default_collate
         self.sharding = sharding
         self.prefetch = int(prefetch)
+        if device_prefetch < 0:
+            raise ValueError("device_prefetch must be >= 0")
+        self.device_prefetch = int(device_prefetch)
         self.mask_key = mask_key
         self.shuffle_buffer = int(shuffle_buffer)
         self.epoch = 0
@@ -236,13 +251,30 @@ class DataLoader:
         ]
         return self._collate_local(samples, valid[lo:hi])
 
-    def _to_device(self, host_batch: Any) -> Any:
-        if self.sharding is None:
+    def _resolve_sharding(self) -> Optional[Any]:
+        """The batch sharding to place with: the explicit one, else a
+        data-axis spec over the active ``mesh_context`` mesh (so prefetch
+        honors GSPMD meshes even when no sharding was wired in), else
+        ``None`` — batches stay on host (clean single-process fallback)."""
+        if self.sharding is not None:
+            return self.sharding
+        from rocket_tpu.parallel.context import current_mesh
+
+        mesh = current_mesh()
+        if mesh is None:
+            return None
+        from rocket_tpu.parallel.sharding import batch_sharding
+
+        return batch_sharding(mesh, ndim=1)
+
+    def _to_device(self, host_batch: Any, sharding: Optional[Any] = None) -> Any:
+        sharding = sharding if sharding is not None else self._resolve_sharding()
+        if sharding is None:
             return host_batch
 
         def place(leaf: Any) -> Any:
             leaf = np.asarray(leaf)
-            sh = self.sharding
+            sh = sharding
             if leaf.ndim < 1:
                 return jax.device_put(leaf)
             if leaf.ndim != len(sh.spec):
@@ -365,11 +397,44 @@ class DataLoader:
                 host_iter = (
                     self._host_batch(idx, valid) for idx, valid in plan
                 )
-        if self.prefetch <= 0:
+        if self.prefetch > 0:
+            host_iter = self._prefetch_iter(host_iter)
+        yield from self._device_iter(host_iter)
+
+    def _device_iter(self, host_iter: Iterator[Any]) -> Iterator[Any]:
+        """The device-transfer stage: issue placement for up to
+        ``device_prefetch`` batches ahead of the consumer.  ``device_put`` /
+        global-array assembly only *enqueues* the H2D copy (JAX transfers
+        are async), so staging ahead costs the host nothing and the next
+        batch is already on-chip when the current step's dispatch returns.
+        Depth 0 degrades to transfer-on-demand (the synchronous behavior).
+
+        The sharding is resolved ONCE per epoch: per-leaf resolution inside
+        a ``mesh_context`` that closes mid-epoch would silently change
+        placement between batches.
+        """
+        from collections import deque
+
+        sharding = self._resolve_sharding()
+        depth = self.device_prefetch
+        if depth <= 0:
             for host_batch in host_iter:
-                yield self._to_device(host_batch)
+                yield self._to_device(host_batch, sharding)
             return
-        yield from self._prefetch_iter(host_iter)
+        staged: deque = deque()
+        try:
+            for host_batch in host_iter:
+                staged.append(self._to_device(host_batch, sharding))
+                if len(staged) > depth:
+                    yield staged.popleft()
+            while staged:
+                yield staged.popleft()
+        finally:
+            # Abandoned mid-epoch: close the upstream promptly so the
+            # prefetch thread / worker pool is shut down now, not at GC.
+            close = getattr(host_iter, "close", None)
+            if close is not None:
+                close()
 
     def _pool_host_batches(self, plan: Iterator[tuple]) -> Iterator[Any]:
         """Host batches via a fork pool of worker processes.  The parent
@@ -461,6 +526,12 @@ class DataLoader:
         )
 
     def _prefetch_iter(self, host_iter: Iterator[Any]) -> Iterator[Any]:
+        """Stage HOST batches through a bounded queue filled by a background
+        thread (device placement is the consumer-side ``_device_iter``'s
+        job).  Producer exceptions propagate to the consumer at the
+        sentinel; on early consumer exit (break / exception / ``close()``)
+        the thread is cancelled AND joined so abandoned epochs don't leak
+        threads or, with ``num_workers``, whole worker pools."""
         q: "queue.Queue" = queue.Queue(maxsize=self.prefetch)
         sentinel = object()
         error: list = []
@@ -500,18 +571,30 @@ class DataLoader:
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()
         try:
-            staged = None
             while True:
                 item = q.get()
                 if item is sentinel:
                     if error:
                         raise error[0]
                     break
-                device_batch = self._to_device(item)
-                if staged is not None:
-                    yield staged
-                staged = device_batch
-            if staged is not None:
-                yield staged
+                yield item
         finally:
             cancel.set()  # abandoned mid-epoch: unblock + clean up producer
+            # Drain whatever the producer managed to enqueue before it saw
+            # the cancel flag, then JOIN: the thread (and any worker pool
+            # whose cleanup lives in host_iter's finally) must be fully shut
+            # down by the time this generator closes, not "eventually".
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break
+            thread.join(timeout=30.0)
+            if thread.is_alive():  # pragma: no cover - defensive
+                import warnings
+
+                warnings.warn(
+                    "DataLoader prefetch thread did not shut down within "
+                    "30s of the consumer exiting",
+                    RuntimeWarning,
+                )

@@ -173,6 +173,17 @@ def build_train_step(
     The DivergenceSentinel's rollback policy passes a cooldown factor
     through it — a changed VALUE is just a new input, only the None→scalar
     transition re-traces once.
+
+    ``donate=True`` (the default) donates the ``TrainState`` argument's
+    buffers to XLA (``donate_argnums=(0,)``): the output state reuses the
+    input's storage, halving peak state memory and sparing a copy per
+    step.  The caller contract is that the OLD state object is dead after
+    the call — the Module upholds it by overwriting ``self._state`` with
+    the step's result before anything else runs, and async checkpoint
+    saves are safe because Orbax's D2H snapshot completes before ``save``
+    returns.  ``donate=False`` (or ``Runtime(donate_train_state=False)``)
+    is the escape hatch for callers that must keep consecutive states
+    alive at once.
     """
     if gradient_accumulation_steps < 1:
         raise ValueError("gradient_accumulation_steps must be >= 1")

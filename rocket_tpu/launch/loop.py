@@ -20,10 +20,29 @@ Module reads to pick its jitted train or eval step; nothing global mutates.
 The tqdm status line reads device scalars lazily and refreshes every
 ``refresh_every`` iterations so progress display never stalls the async
 dispatch queue.
+
+**Non-blocking mode** (``readback_lag=k``, k >= 1): the loop becomes
+dispatch-and-go.  Each iteration's ``attrs.step_logs`` scalars are staged
+with ``copy_to_host_async`` (the DivergenceSentinel's delayed-read
+discipline) into a window of k in-flight iterations; the value read back
+each iteration is the one staged k iterations ago, whose transfer has long
+landed.  That read doubles as the **bounded in-flight window**: it blocks
+only when the host has run more than k steps ahead of the device, which is
+exactly the backpressure that keeps the dispatch queue finite.  The lagged
+host floats are published as ``attrs.looper.lagged_logs`` for observers
+(Throughput credits completed steps off it; the status bar formats it) so
+nothing calls ``block_until_ready`` mid-epoch — syncs happen only at epoch
+boundaries (cycle reset), checkpoint points (the save's D2H copy), and stop
+votes.  The per-iteration **host dispatch gap** (host time spent outside
+the backpressure wait — the time the chip could sit idle between steps) is
+measured every iteration and exposed as :attr:`Looper.last_dispatch_gap_ms`
+for the bench ladder and the async-loop regression guard.
 """
 
 from __future__ import annotations
 
+import time
+from collections import deque
 from typing import Any, Iterable, Optional
 
 from rocket_tpu.core.attributes import Attributes
@@ -36,6 +55,65 @@ except ImportError:  # pragma: no cover
 
     def colored(text: str, *args: Any, **kwargs: Any) -> str:
         return text
+
+
+class _LagWindow:
+    """A k-deep window of staged ``step_logs`` snapshots.
+
+    ``push`` stages the current iteration's device scalars with
+    ``copy_to_host_async`` (starting their D2H transfers immediately) and,
+    once the window holds more than ``lag`` entries, materializes the
+    OLDEST one to host floats.  Materializing blocks only if that step —
+    dispatched ``lag`` iterations ago — has not finished yet, which is the
+    loop's backpressure point; in steady state the transfer landed long ago
+    and the floats are free (the sentinel's ``_stage_and_read`` pattern,
+    widened from one scalar to the whole logs dict).
+    """
+
+    def __init__(self, lag: int) -> None:
+        self.lag = max(1, int(lag))
+        self._window: deque = deque()
+
+    def __len__(self) -> int:
+        return len(self._window)
+
+    @staticmethod
+    def _stage(logs: Any) -> dict:
+        staged = {}
+        for key, value in dict(logs).items():
+            start = getattr(value, "copy_to_host_async", None)
+            if start is not None:
+                try:
+                    start()
+                except Exception:
+                    pass  # already on host (numpy / python scalar)
+            staged[key] = value
+        return staged
+
+    @staticmethod
+    def _materialize(staged: dict) -> Attributes:
+        out = Attributes()
+        for key, value in staged.items():
+            try:
+                out[key] = float(value)  # free: transfer landed k steps ago
+            except (TypeError, ValueError):
+                out[key] = value  # host-side passthrough (bools, strings)
+        return out
+
+    def push(self, logs: Any) -> Optional[Attributes]:
+        """Stage ``logs``; return the (k+1)-iterations-old snapshot as host
+        floats once the window is full, else ``None`` (still filling)."""
+        self._window.append(self._stage(logs))
+        if len(self._window) <= self.lag:
+            return None
+        return self._materialize(self._window.popleft())
+
+    def clear(self) -> None:
+        """Epoch-boundary / stop-vote sync point: drop the in-flight tail.
+        The staged buffers may be donated away between cycles — holding
+        them across the boundary would read freed storage (the same reason
+        the sentinel drops its staged scalars at ``reset``)."""
+        self._window.clear()
 
 
 class Looper(Dispatcher):
@@ -54,6 +132,13 @@ class Looper(Dispatcher):
         Run the cycle only on epochs divisible by this (``loop.py:91-113``).
     tag:
         Progress-bar label (default TRAIN/EVAL by grad mode).
+    readback_lag:
+        ``k >= 1`` arms the non-blocking loop: loss/metric host readback is
+        deferred by ``k`` iterations (the sentinel's delayed-read pattern)
+        and at most ``k`` steps stay in flight (the lagged read is the
+        backpressure bound).  ``0`` (default) is the synchronous loop.
+        Results are bit-identical either way — only host-side readback
+        timing changes, never the dispatched program or its order.
     """
 
     def __init__(
@@ -65,6 +150,7 @@ class Looper(Dispatcher):
         tag: Optional[str] = None,
         progress: bool = True,
         refresh_every: int = 10,
+        readback_lag: int = 0,
         statefull: bool = True,
         priority: int = 1000,
         logger: Optional[Any] = None,
@@ -81,6 +167,13 @@ class Looper(Dispatcher):
         self._tag = tag or ("TRAIN" if grad_enabled else "EVAL")
         self._progress = progress
         self._refresh_every = max(1, refresh_every)
+        if readback_lag < 0:
+            raise ValueError("readback_lag must be >= 0")
+        self._readback_lag = int(readback_lag)
+        self._lag_window: Optional[_LagWindow] = None
+        self._lagged_state: Optional[Attributes] = None
+        self._gap_sum = 0.0
+        self._gap_count = 0
         self._iter_idx = 0
 
     def guard(self) -> None:
@@ -130,7 +223,17 @@ class Looper(Dispatcher):
             terminate=False,
             tag=self._tag,
             grad_enabled=self._grad_enabled,
+            # async-loop protocol: observers (Throughput, user capsules)
+            # read the lag and, per iteration, the k-lagged host floats.
+            readback_lag=self._readback_lag,
+            lagged_logs=None,
         )
+        self._lag_window = (
+            _LagWindow(self._readback_lag) if self._readback_lag > 0 else None
+        )
+        self._lagged_state = None
+        self._gap_sum = 0.0
+        self._gap_count = 0
         super().set(attrs)
 
     def reset(self, attrs: Optional[Attributes] = None) -> None:
@@ -139,6 +242,20 @@ class Looper(Dispatcher):
         super().reset(attrs)
         del attrs.looper
         self._iter_idx = 0
+        # Epoch-boundary sync point: drop the in-flight readback tail.
+        if self._lag_window is not None:
+            self._lag_window.clear()
+        self._lagged_state = None
+
+    @property
+    def last_dispatch_gap_ms(self) -> Optional[float]:
+        """Mean host dispatch gap of the current/most recent cycle, in ms:
+        host time per iteration spent dispatching capsules — i.e. outside
+        the lag window's backpressure wait — which is the time the chip
+        sits idle between steps.  ``None`` before the first iteration."""
+        if self._gap_count == 0:
+            return None
+        return self._gap_sum / self._gap_count * 1e3
 
     def launch(self, attrs: Optional[Attributes] = None) -> None:
         attrs = attrs if attrs is not None else Attributes()
@@ -157,10 +274,12 @@ class Looper(Dispatcher):
             from rocket_tpu.core.dispatcher import _tracer
 
             tracer = _tracer()
+        window = self._lag_window
         try:
             # repeats=None: unbounded streaming cycle, ended by the child
             # Dataset's termination vote when the stream exhausts.
             while looper.repeats is None or self._iter_idx < looper.repeats:
+                gap_t0 = time.perf_counter()
                 attrs.batch = None
                 # Cleared WITH the batch: an iteration where no step runs
                 # (dataset exhausted on a resumed epoch) must not re-expose
@@ -178,6 +297,21 @@ class Looper(Dispatcher):
                 else:
                     for capsule in self._capsules:
                         capsule.launch(attrs)
+                # Host dispatch gap: everything above ran without waiting
+                # on the device (in async mode); the backpressure wait
+                # below is device time and deliberately NOT counted.
+                self._gap_sum += time.perf_counter() - gap_t0
+                self._gap_count += 1
+                if window is not None:
+                    looper.lagged_logs = None
+                    if attrs.step_logs is not None:
+                        popped = window.push(attrs.step_logs)
+                        if popped is not None:
+                            # In-flight bound: materializing the snapshot
+                            # staged k iterations ago blocks only when the
+                            # host is > k steps ahead of the device.
+                            looper.lagged_logs = popped
+                            self._lagged_state = popped
                 self._iter_idx += 1
                 if looper.terminate or (
                     self._runtime is not None and self._runtime.stop_training
@@ -188,7 +322,13 @@ class Looper(Dispatcher):
                 if bar is not None:
                     bar.update(1)
                     if self._iter_idx % self._refresh_every == 0:
-                        bar.set_postfix(self._format_state(looper.state))
+                        # Async mode: the postfix formats the k-lagged host
+                        # floats — a refresh must never sync mid-epoch.
+                        bar.set_postfix(
+                            self._format_state(looper.state)
+                            if window is None
+                            else self._format_lagged(looper.state)
+                        )
         finally:
             if bar is not None:
                 bar.set_postfix(self._format_state(looper.state))
@@ -213,6 +353,27 @@ class Looper(Dispatcher):
             leave=True,
             dynamic_ncols=True,
         )
+
+    def _format_lagged(self, state: Optional[Attributes]) -> dict:
+        """Non-blocking postfix: host-native entries of the looper state
+        (strings the Throughput meter writes, python floats) format as
+        usual; device scalars are replaced by their k-lagged host floats
+        from the lag window, or skipped while the window is still filling.
+        Nothing here can stall the dispatch queue."""
+        lagged = self._lagged_state
+        out = {}
+        for key, value in (state or {}).items():
+            if isinstance(value, (str, int, float, bool)):
+                try:
+                    out[key] = f"{float(value):.4g}"
+                except (TypeError, ValueError):
+                    out[key] = str(value)
+            elif lagged is not None and key in lagged:
+                try:
+                    out[key] = f"{float(lagged[key]):.4g}"
+                except (TypeError, ValueError):
+                    out[key] = str(lagged[key])
+        return out
 
     @staticmethod
     def _format_state(state: Optional[Attributes]) -> dict:

@@ -122,6 +122,18 @@ class Profiler(Capsule):
 class Throughput(Capsule):
     """samples/sec + step wall-clock, EMA-smoothed, on the status line and
     tracker. Reads the batch's leading dim (global batch) from ``attrs.batch``.
+
+    Under a non-blocking Looper (``readback_lag=k``), wall-clock between
+    *dispatches* is the wrong denominator: the first k dispatches return in
+    microseconds while the device is still filling the pipeline, so
+    ``size/dt`` would report absurd rates for steps that have not finished.
+    In lag mode samples are **counted at dispatch time** (every launch
+    pushes the batch size onto an in-flight queue) but **timed against the
+    lagged readback**: a window closes only when ``attrs.looper.
+    lagged_logs`` lands — proof one more step actually completed — and the
+    rate credits exactly that step's samples over the time since the
+    previous readback.  Pipeline-fill dispatches therefore never inflate
+    samples/sec, and nothing here syncs the device either way.
     """
 
     def __init__(
@@ -131,11 +143,13 @@ class Throughput(Capsule):
         log_every: int = 50,
         priority: int = 300,  # after Module, before Tracker flush
         logger: Optional[Any] = None,
+        clock: Optional[Any] = None,
     ) -> None:
         super().__init__(statefull=False, priority=priority, logger=logger)
         self._ema_factor = ema
         self._tag = tag
         self._log_every = log_every
+        self._clock = clock or time.perf_counter  # injectable for tests
         self._last_time: Optional[float] = None
         self._ema: Optional[float] = None
         self._iter = 0          # within-cycle counter (log_every cadence)
@@ -144,6 +158,9 @@ class Throughput(Capsule):
         # TensorBoard) — the ImageLogger uses the same two-counter scheme
         self._last_dt: Optional[float] = None
         self._pending = False   # readings observed since the last record
+        from collections import deque
+
+        self._inflight: Any = deque()  # dispatched-not-yet-read-back sizes
 
     def set(self, attrs: Optional[Attributes] = None) -> None:
         # Full cycle-boundary reset — including ``_iter``: leaving it
@@ -156,9 +173,17 @@ class Throughput(Capsule):
         self._iter = 0
         self._last_dt = None
         self._pending = False
+        self._inflight.clear()
 
     def launch(self, attrs: Optional[Attributes] = None) -> None:
-        now = time.perf_counter()
+        now = self._clock()
+        looper = attrs.looper if attrs is not None else None
+        lag = 0
+        if looper is not None:
+            lag = int(looper.get("readback_lag") or 0)
+        if lag > 0:
+            self._launch_lagged(attrs, looper, now)
+            return
         if self._last_time is None:
             self._last_time = now
             return
@@ -166,6 +191,28 @@ class Throughput(Capsule):
         self._last_time = now
         batch = attrs.batch if attrs is not None else None
         size = _batch_size(batch)
+        self._observe(attrs, looper, size, dt)
+
+    def _launch_lagged(self, attrs: Attributes, looper: Any, now: float) -> None:
+        """Lag-mode accounting: count at dispatch, time at readback."""
+        size = _batch_size(attrs.batch)
+        if size:
+            self._inflight.append(size)
+        if self._last_time is None:
+            # The window opens at the FIRST dispatch: the device starts
+            # working here, so the first readback's dt spans exactly one
+            # completed step plus pipeline fill.
+            self._last_time = now
+            return
+        if looper.get("lagged_logs") is None or not self._inflight:
+            return  # nothing read back yet: count samples, don't time them
+        dt = now - self._last_time
+        self._last_time = now
+        self._observe(attrs, looper, self._inflight.popleft(), dt)
+
+    def _observe(
+        self, attrs: Optional[Attributes], looper: Any, size: int, dt: float
+    ) -> None:
         rate = size / dt if dt > 0 else 0.0
         self._ema = (
             rate
@@ -178,7 +225,6 @@ class Throughput(Capsule):
         self._pending = True
         if attrs is None:
             return
-        looper = attrs.looper
         if looper is not None and looper.state is not None:
             looper.state[self._tag] = f"{self._ema:,.0f}/s"
         if (

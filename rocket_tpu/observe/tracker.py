@@ -15,11 +15,22 @@ TPU-first: records hold **device scalars** (lazy jax arrays); conversion to
 floats happens only at flush, every ``flush_every`` iterations — so logging
 adds zero host-device synchronization to the steady-state loop (the
 reference synced every iteration; SURVEY §2.4 flags the cost).
+
+Under a non-blocking Looper (``attrs.looper.readback_lag=k``), flushing is
+additionally **held back by k iterations**: a record appended this
+iteration references a value the device may not have computed yet, so
+``float()``-ing it at an unlucky flush boundary would stall the dispatch
+queue.  Arriving records get their D2H transfers started immediately
+(``copy_to_host_async`` — the sentinel's delayed-read discipline) and
+become flush-eligible only k launches later, by which point the transfer
+has landed and conversion is free.  The cycle-end flush (``reset``) drains
+everything — that is an epoch-boundary sync point by contract.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from collections import deque
+from typing import Any, List, Optional
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
@@ -40,6 +51,12 @@ class Tracker(Capsule):
         self._backend: Optional[TrackerBackend] = None
         self._flush_every = max(1, int(flush_every))
         self._since_flush = 0
+        # Readback-lag holdback (non-blocking Looper): (launch_idx,
+        # records) batches aging toward flush eligibility, and the aged
+        # records ready for the next flush.
+        self._held: deque = deque()
+        self._ready: List[Any] = []
+        self._launch_idx = 0
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -78,6 +95,9 @@ class Tracker(Capsule):
 
     def set(self, attrs: Optional[Attributes] = None) -> None:
         """Open the per-cycle buffers (reference ``tracker.py:107-124``)."""
+        self._held.clear()
+        self._ready = []
+        self._launch_idx = 0
         if attrs is None:
             return
         attrs.tracker = Attributes(scalars=[], images=[])
@@ -85,14 +105,47 @@ class Tracker(Capsule):
     def launch(self, attrs: Optional[Attributes] = None) -> None:
         if attrs is None or attrs.tracker is None:
             return
+        lag = 0
+        if attrs.looper is not None:
+            lag = int(attrs.looper.get("readback_lag") or 0)
+        if lag > 0:
+            self._age_scalars(attrs.tracker, lag)
+        self._launch_idx += 1
         self._since_flush += 1
         if self._since_flush >= self._flush_every:
             self.log(attrs)
 
+    def _age_scalars(self, tracker: Attributes, lag: int) -> None:
+        """Move this iteration's scalar arrivals into the holdback window
+        (starting their async D2H transfers now) and promote records aged
+        past the in-flight window to the flush-ready list.  A record from
+        iteration ``i`` is guaranteed landed only once the Looper's
+        backpressure pop has materialized step ``i`` — which happens at the
+        END of iteration ``i + lag`` — so mid-epoch eligibility is
+        ``lag + 1`` launches old, never merely ``lag``: flushing one
+        iteration earlier would move the device wait INTO the dispatch
+        path the lag exists to keep clear."""
+        arrivals, tracker.scalars = tracker.scalars, []
+        if arrivals:
+            for record in arrivals:
+                for value in record.data.values():
+                    start = getattr(value, "copy_to_host_async", None)
+                    if start is not None:
+                        try:
+                            start()
+                        except Exception:
+                            pass  # already on host
+            self._held.append((self._launch_idx, arrivals))
+        while self._held and self._held[0][0] <= self._launch_idx - lag - 1:
+            self._ready.extend(self._held.popleft()[1])
+
     def reset(self, attrs: Optional[Attributes] = None) -> None:
-        """Final flush + drop the buffers (reference ``tracker.py:154-180``)."""
+        """Final flush + drop the buffers (reference ``tracker.py:154-180``).
+        Cycle end is a sync point: the holdback window drains fully."""
         if attrs is None or attrs.tracker is None:
             return
+        while self._held:
+            self._ready.extend(self._held.popleft()[1])
         self.log(attrs)
         del attrs.tracker
 
@@ -100,12 +153,16 @@ class Tracker(Capsule):
 
     def log(self, attrs: Attributes) -> None:
         """Drain buffers to the backend; writes on the main process only
-        (reference ``tracker.py:201-254``)."""
+        (reference ``tracker.py:201-254``).  In lag mode mid-epoch, only
+        aged (transfer-landed) records are in the drained buffers — the
+        holdback window keeps the rest."""
         self._since_flush = 0
         tracker = attrs.tracker
         if tracker is None or self._backend is None:
             return
         scalars, tracker.scalars = tracker.scalars, []
+        scalars = self._ready + scalars
+        self._ready = []
         images, tracker.images = tracker.images, []
         if self._runtime is not None and not self._runtime.is_main_process:
             return

@@ -272,13 +272,19 @@ class Checkpointer(Capsule):
         manifest = integrity.build_manifest(
             items, iter_idx=self._iter_idx, epoch_idx=self._epoch_idx,
         )
-        default_io().save(path, items, force=True, manifest=manifest)
-        self._logger.info("checkpoint -> %s", path)
-        # Retention across restarts comes from the setup() disk scan, not
-        # from persisting this list.
+        # Prune BEFORE issuing the new async save: _prune() must wait() out
+        # any in-flight write before deleting around it, and done in this
+        # order that wait drains the PREVIOUS save (long since overlapped
+        # with compute) instead of the one about to be issued — pruning
+        # after would synchronously drain the new save every time retention
+        # is active, killing the save/compute overlap.  Retention across
+        # restarts comes from the setup() disk scan, not from persisting
+        # this list.
         if track:
             self._saved_dirs.append(path)
             self._prune()
+        default_io().save(path, items, force=True, manifest=manifest)
+        self._logger.info("checkpoint -> %s", path)
         return path
 
     # -- best-k by metric ----------------------------------------------------

@@ -53,6 +53,7 @@ class Runtime:
         seed: int = 0,
         tracing: bool = False,
         trace_capacity: int = 4096,
+        donate_train_state: bool = True,
     ) -> None:
         if mesh is None:
             mesh = data_parallel_mesh()
@@ -93,6 +94,10 @@ class Runtime:
         # Set by DivergenceSentinel(policy="skip") at setup; Module reads it
         # when building the jitted steps (engine.step skip_nonfinite guard).
         self.skip_nonfinite_updates = False
+        # Run-level escape hatch for train-state buffer donation: Modules
+        # that were not given an explicit ``donate=`` resolve it from here
+        # at step-build time (engine.step donate_argnums).
+        self.donate_train_state = bool(donate_train_state)
         # Pending resume request (set by Launcher.resume): Attributes with
         # ``path`` and ``load_capsules``.  Capsules with lazily-materialized
         # array state (Module) consume it at materialization time; host-scalar

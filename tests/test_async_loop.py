@@ -1,0 +1,339 @@
+"""Async train hot-path tests — device prefetch, non-blocking Looper,
+lagged readback, donation.
+
+Covers the PR-5 acceptance criteria:
+
+- the async loop (``readback_lag>=1``, ``device_prefetch>=1``, donation on)
+  is BIT-IDENTICAL to the synchronous loop: same params, same optimizer
+  state, same per-iteration loss series — for every lag × prefetch-depth
+  combination, including a run resumed from a checkpoint;
+- ``attrs.looper.lagged_logs`` delivers exactly the k-iterations-old host
+  floats of the sync loss series;
+- donation adds zero extra jit traces across warm cycles (micro AND sync
+  accumulation paths) and changes no results; ``donate=False`` and
+  ``Runtime(donate_train_state=False)`` are working escape hatches;
+- Throughput in lag mode counts samples at dispatch but times windows
+  against the lagged readback, so pipeline-fill dispatches never inflate
+  samples/sec (fake-clock unit test);
+- a mid-epoch SIGTERM with steps still in flight commits a valid
+  checkpoint, and auto-resume completes the run on the sync trajectory.
+"""
+
+import numpy as np
+import pytest
+
+import rocket_tpu as rt
+from rocket_tpu.models.objectives import cross_entropy
+from rocket_tpu.persist import integrity
+from rocket_tpu.testing import SigtermInjector
+
+from test_pipeline import MLP, synthetic_classification
+
+
+class LossRecorder(rt.Capsule):
+    """Host-side per-iteration loss trace (sync read — test-only)."""
+
+    def __init__(self):
+        super().__init__(statefull=False, priority=400)
+        self.losses = []
+
+    def launch(self, attrs=None):
+        if attrs is None or attrs.step_logs is None:
+            return
+        looper = attrs.looper
+        if looper is not None and not looper.grad_enabled:
+            return
+        loss = attrs.step_logs.get("loss")
+        if loss is not None:
+            self.losses.append(float(loss))
+
+
+class LaggedRecorder(rt.Capsule):
+    """Records the host floats the non-blocking loop publishes as
+    ``attrs.looper.lagged_logs`` — the observer-side view of readback."""
+
+    def __init__(self):
+        super().__init__(statefull=False, priority=300)
+        self.losses = []
+
+    def launch(self, attrs=None):
+        if attrs is None or attrs.looper is None:
+            return
+        lagged = attrs.looper.get("lagged_logs")
+        if lagged is None:
+            return
+        loss = lagged.get("loss")
+        if loss is not None:
+            self.losses.append(float(loss))
+
+
+def _tree(tmp_path, data, *, tag, epochs, lag=0, depth=1, extra=(),
+          save_every=100, resume=None, donate=None, runtime=None):
+    """Standard tree: 256 samples / batch 64 = 4 iterations per epoch,
+    parameterized by readback lag and device-prefetch depth."""
+    model = rt.Module(
+        MLP(),
+        capsules=[
+            rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+            rt.Optimizer(learning_rate=2e-2),
+        ],
+        donate=donate,
+    )
+    recorder = LossRecorder()
+    looper = rt.Looper(
+        capsules=[
+            rt.Dataset(rt.ArraySource(data), batch_size=64, shuffle=True,
+                       seed=7, device_prefetch=depth),
+            model,
+            *extra,
+            recorder,
+            rt.Checkpointer(save_every=save_every),
+        ],
+        progress=False,
+        readback_lag=lag,
+    )
+    launcher = rt.Launcher(
+        capsules=[looper], tag=tag, num_epochs=epochs,
+        project_root=str(tmp_path), seed=0, runtime=runtime,
+    )
+    if resume is not None:
+        launcher.resume(resume)
+    return launcher, model, recorder
+
+
+def _flat(tree):
+    import jax
+
+    return np.concatenate([
+        np.ravel(np.asarray(x)) for x in jax.tree_util.tree_leaves(tree)
+    ])
+
+
+# -- acceptance: bitwise trajectory equality ---------------------------------
+
+
+@pytest.mark.parametrize("lag", [1, 2])
+@pytest.mark.parametrize("depth", [0, 1, 2])
+def test_async_bitwise_matches_sync(tmp_path, devices, lag, depth):
+    """THE acceptance test: the async loop never changes the dispatched
+    program or its order, so params, optimizer state and the loss series
+    are bit-identical to the synchronous loop's."""
+    data = synthetic_classification(n=256)
+    ref, model_ref, rec_ref = _tree(tmp_path, data, tag="sync-ref", epochs=2)
+    ref.launch()
+    assert len(rec_ref.losses) == 8
+
+    run, model, rec = _tree(
+        tmp_path, data, tag=f"async-{lag}-{depth}", epochs=2,
+        lag=lag, depth=depth,
+    )
+    run.launch()
+    assert rec.losses == rec_ref.losses  # exact float equality, no tolerance
+    np.testing.assert_array_equal(
+        _flat(model.state.params), _flat(model_ref.state.params)
+    )
+    np.testing.assert_array_equal(
+        _flat(model.state.opt_state), _flat(model_ref.state.opt_state)
+    )
+    assert run._capsules[0].last_dispatch_gap_ms is not None
+
+
+def test_lagged_logs_trail_sync_series(tmp_path, devices):
+    """``lagged_logs`` is exactly the sync loss series delayed: an observer
+    dispatched during iteration ``i`` sees the snapshot popped at the end of
+    iteration ``i-1``, i.e. step ``i-1-k`` — so over an 8-iteration epoch it
+    records the first ``8-k-1`` sync losses, in order."""
+    lag = 2
+    data = synthetic_classification(n=512)  # 8 iters/epoch at bs 64
+    ref, _, rec_ref = _tree(tmp_path, data, tag="lag-ref", epochs=1)
+    ref.launch()
+    assert len(rec_ref.losses) == 8
+
+    lagged = LaggedRecorder()
+    run, _, rec = _tree(tmp_path, data, tag="lag-obs", epochs=1, lag=lag,
+                        depth=2, extra=[lagged])
+    run.launch()
+    assert rec.losses == rec_ref.losses
+    assert lagged.losses == rec_ref.losses[: 8 - lag - 1]
+
+
+@pytest.mark.resilience
+def test_sigterm_midflight_commits_and_resumes(tmp_path, devices):
+    """Chaos: SIGTERM mid-epoch with up to k steps in flight still commits
+    a verifiable checkpoint (the save's D2H copy is the sync point), and
+    auto-resume — itself async — finishes on the sync trajectory."""
+    data = synthetic_classification(n=256)
+    ref, model_ref, rec_ref = _tree(tmp_path, data, tag="ca-ref", epochs=2)
+    ref.launch()
+
+    run_b, _, rec_b = _tree(
+        tmp_path, data, tag="ca", epochs=2, lag=2, depth=2,
+        extra=[SigtermInjector(at_iter=2)],
+    )
+    run_b.launch()
+    assert len(rec_b.losses) == 3  # iters 0..2, then the grace-window stop
+    snap = tmp_path / "ca" / "v0" / "weights" / "000002"
+    assert snap.is_dir()
+    ok, reason = integrity.verify(str(snap))
+    assert ok, reason
+
+    run_c, model_c, rec_c = _tree(
+        tmp_path, data, tag="ca", epochs=2, lag=2, depth=2, resume="auto",
+    )
+    run_c.launch()
+    stitched = rec_b.losses + rec_c.losses
+    assert len(stitched) == 8
+    np.testing.assert_allclose(stitched, rec_ref.losses, rtol=1e-6, atol=0)
+    np.testing.assert_allclose(
+        _flat(model_c.state.params), _flat(model_ref.state.params),
+        rtol=1e-6, atol=0,
+    )
+
+
+# -- donation ----------------------------------------------------------------
+
+
+class StepTraceProbe(rt.Capsule):
+    """Snapshots the jit cache sizes of the module's micro/sync steps every
+    iteration — ``Module.destroy`` drops ``_steps``, so trace counts must be
+    observed while the run is live."""
+
+    def __init__(self, model):
+        super().__init__(statefull=False, priority=200)
+        self._model = model
+        self.sizes = set()
+
+    def launch(self, attrs=None):
+        steps = self._model._steps
+        if steps and "sync" in steps:
+            self.sizes.add((
+                steps["micro"]._cache_size(), steps["sync"]._cache_size(),
+            ))
+
+
+def test_donation_zero_retrace_and_bitwise(tmp_path, devices):
+    """Donation (the default) adds zero jit traces across warm cycles on
+    BOTH accumulation paths and changes no results vs ``donate=False``."""
+    data = synthetic_classification(n=256)
+    run_a, model_a, rec_a = _tree(
+        tmp_path, data, tag="don-on", epochs=2, lag=1,
+        runtime=rt.Runtime(gradient_accumulation_steps=2),
+    )
+    probe = StepTraceProbe(model_a)
+    run_a._capsules[0]._capsules.append(probe)
+    run_a.launch()
+    assert model_a._donate is True  # resolved from the runtime default
+    # each step body traced exactly once, no retraces across warm cycles
+    assert max(probe.sizes) == (1, 1)
+    assert all(m <= 1 and s <= 1 for m, s in probe.sizes)
+
+    run_b, model_b, rec_b = _tree(
+        tmp_path, data, tag="don-off", epochs=2, lag=1, donate=False,
+        runtime=rt.Runtime(gradient_accumulation_steps=2),
+    )
+    run_b.launch()
+    assert model_b._donate is False
+    assert rec_a.losses == rec_b.losses
+    np.testing.assert_array_equal(
+        _flat(model_a.state.params), _flat(model_b.state.params)
+    )
+    np.testing.assert_array_equal(
+        _flat(model_a.state.opt_state), _flat(model_b.state.opt_state)
+    )
+
+
+def test_runtime_donate_escape_hatch(devices):
+    """``Runtime(donate_train_state=False)`` turns donation off for every
+    Module that did not pin it explicitly; the default resolves to True."""
+    import jax.numpy as jnp
+
+    data = synthetic_classification(n=64)
+    batch = {"x": jnp.asarray(data["x"]), "label": jnp.asarray(data["label"])}
+
+    def build(runtime, donate=None):
+        model = rt.Module(
+            MLP(),
+            capsules=[
+                rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                rt.Optimizer(learning_rate=2e-2),
+            ],
+            donate=donate,
+        )
+        model.bind(runtime)
+        model.setup()
+        attrs = rt.Attributes(
+            batch=batch,
+            looper=rt.Attributes(grad_enabled=True, state=rt.Attributes()),
+        )
+        model.launch(attrs)
+        return model
+
+    assert build(rt.Runtime())._donate is True
+    assert build(rt.Runtime(donate_train_state=False))._donate is False
+    # explicit Module donate pins over the runtime either way
+    assert build(rt.Runtime(donate_train_state=False), donate=True)._donate \
+        is True
+
+
+# -- throughput accounting under lag -----------------------------------------
+
+
+class TestThroughputLagMode:
+    def _attrs(self, lag):
+        looper = rt.Attributes(
+            readback_lag=lag, lagged_logs=None,
+            state=rt.Attributes(), grad_enabled=True,
+        )
+        return rt.Attributes(
+            looper=looper,
+            batch={"x": np.zeros((8, 4), np.float32)},
+            tracker=None,
+        )
+
+    def test_pipeline_fill_never_inflates_rate(self):
+        """Dispatches before the first readback return in microseconds —
+        they must count samples, not mint absurd rates."""
+        from rocket_tpu.observe.profile import Throughput
+
+        times = iter([0.0, 10.0, 20.0, 30.0])
+        tp = Throughput(ema=0.5, log_every=1000, clock=lambda: next(times))
+        attrs = self._attrs(lag=2)
+        tp.set(attrs)
+        tp.launch(attrs)  # t=0: first dispatch opens the window
+        assert tp._ema is None
+        tp.launch(attrs)  # t=10: still filling, nothing read back
+        assert tp._ema is None
+        assert len(tp._inflight) == 2  # samples counted at dispatch
+
+        attrs.looper.lagged_logs = rt.Attributes(loss=0.1)
+        tp.launch(attrs)  # t=20: first completed step -> 8 samples / 20s
+        assert tp._ema == pytest.approx(8 / 20.0)
+        tp.launch(attrs)  # t=30: one more readback -> 8/10, EMA-blended
+        assert tp._ema == pytest.approx(0.5 * (8 / 20.0) + 0.5 * (8 / 10.0))
+        assert attrs.looper.state["throughput"].endswith("/s")
+
+    def test_sync_mode_unchanged(self):
+        from rocket_tpu.observe.profile import Throughput
+
+        times = iter([0.0, 1.0, 2.0])
+        tp = Throughput(ema=0.5, log_every=1000, clock=lambda: next(times))
+        attrs = self._attrs(lag=0)
+        tp.set(attrs)
+        tp.launch(attrs)  # t=0: baseline only
+        assert tp._ema is None
+        tp.launch(attrs)  # t=1: 8 samples / 1s
+        assert tp._ema == pytest.approx(8.0)
+
+    def test_cycle_reset_clears_inflight(self):
+        from rocket_tpu.observe.profile import Throughput
+
+        times = iter([0.0, 10.0, 0.0])
+        tp = Throughput(ema=0.5, log_every=1000, clock=lambda: next(times))
+        attrs = self._attrs(lag=2)
+        tp.set(attrs)
+        tp.launch(attrs)
+        tp.launch(attrs)
+        assert len(tp._inflight) == 2
+        tp.set(attrs)  # next cycle: stale in-flight sizes must not leak
+        assert len(tp._inflight) == 0
+        assert tp._ema is None

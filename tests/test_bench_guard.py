@@ -289,3 +289,176 @@ class TestTracingOverheadGuard:
         assert armed <= bare * 1.05 + 5e-4, (
             f"armed round {armed * 1e3:.3f}ms vs bare {bare * 1e3:.3f}ms"
         )
+
+
+# -- async-loop guard (ISSUE 5 acceptance) --------------------------------
+#
+# The non-blocking Looper's promise: with readback deferred k iterations,
+# the per-iteration HOST dispatch gap (the time the chip could sit idle
+# between steps) drops strictly below the synchronous loop's — which pays
+# a device wait every iteration to float the fresh loss — while tracing
+# zero additional step bodies and adding <5% host overhead when nothing
+# consumes the readback at all.  The model is sized so the device step
+# clearly dominates python dispatch on CPU, making the gap comparison
+# meaningful rather than noise-vs-noise.
+
+
+class TestAsyncLoopGuard:
+    REPEATS = 12
+    BATCH = 128
+
+    def _data(self):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        n = self.REPEATS * self.BATCH
+        protos = rng.normal(size=(4, 64)).astype(np.float32) * 3.0
+        labels = rng.integers(0, 4, size=n)
+        x = (protos[labels] + rng.normal(size=(n, 64))).astype(np.float32)
+        return {"x": x, "label": labels.astype(np.int32)}
+
+    def _build(self, lag, reader):
+        import flax.linen as nn
+
+        import rocket_tpu as rt
+        from rocket_tpu.models.objectives import cross_entropy
+
+        class WideMLP(nn.Module):
+            @nn.compact
+            def __call__(self, batch, train=False):
+                x = batch["x"]
+                x = nn.relu(nn.Dense(512)(x))
+                x = nn.relu(nn.Dense(512)(x))
+                out = rt.Attributes(batch)
+                out["logits"] = nn.Dense(4)(x)
+                return out
+
+        model = rt.Module(
+            WideMLP(),
+            capsules=[
+                rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                rt.Optimizer(learning_rate=1e-2),
+            ],
+        )
+        capsules = [
+            rt.Dataset(rt.ArraySource(self._data()), batch_size=self.BATCH,
+                       device_prefetch=2),
+            model,
+        ]
+        if reader is not None:
+            capsules.append(reader)
+        looper = rt.Looper(capsules=capsules, progress=False,
+                           readback_lag=lag)
+        # Single-device mesh: dispatch of an executable sharded over the 8
+        # FAKE cpu devices blocks on the previous step (an artifact of the
+        # forced-host-platform device emulation, not of the loop) — which
+        # would drown the readback-wait difference this guard measures.
+        # On one device the CPU client pipelines dispatches like a real
+        # accelerator, making the gap comparison meaningful.
+        import jax
+
+        from rocket_tpu.parallel.mesh import data_parallel_mesh
+
+        looper.bind(rt.Runtime(mesh=data_parallel_mesh(jax.devices()[:1])))
+        attrs = rt.Attributes()
+        looper.setup(attrs)
+        return looper, model, attrs
+
+    @staticmethod
+    def _sync_reader():
+        import rocket_tpu as rt
+
+        class SyncReader(rt.Capsule):
+            """The classic loop: floats THIS iteration's loss during
+            dispatch — a device wait on the hot path every iteration."""
+
+            def __init__(self):
+                super().__init__(statefull=False, priority=300)
+                self.seen = 0
+
+            def launch(self, attrs=None):
+                if attrs is not None and attrs.step_logs is not None:
+                    float(attrs.step_logs["loss"])
+                    self.seen += 1
+
+        return SyncReader()
+
+    @staticmethod
+    def _lagged_reader():
+        import rocket_tpu as rt
+
+        class LaggedReader(rt.Capsule):
+            """Consumes the k-lagged host floats — no device wait."""
+
+            def __init__(self):
+                super().__init__(statefull=False, priority=300)
+                self.seen = 0
+
+            def launch(self, attrs=None):
+                if attrs is None or attrs.looper is None:
+                    return
+                lagged = attrs.looper.get("lagged_logs")
+                if lagged is not None:
+                    float(lagged["loss"])
+                    self.seen += 1
+
+        return LaggedReader()
+
+    def _gap_ms(self, lag, reader, trials=3):
+        import jax
+
+        looper, model, attrs = self._build(lag, reader)
+        looper.launch(attrs)  # warmup cycle (compiles)
+        looper.reset(attrs)
+        jax.block_until_ready(model.state.params)
+        gaps = []
+        for _ in range(trials):
+            looper.launch(attrs)
+            gaps.append(looper.last_dispatch_gap_ms)
+            looper.reset(attrs)
+            jax.block_until_ready(model.state.params)
+        # the async plumbing traced ZERO new step bodies across cycles
+        assert model._steps["sync"]._cache_size() == 1
+        return min(gaps)
+
+    def test_async_dispatch_gap_beats_sync(self, devices):
+        sync_reader = self._sync_reader()
+        gap_sync = self._gap_ms(0, sync_reader)
+        lagged_reader = self._lagged_reader()
+        gap_async = self._gap_ms(2, lagged_reader)
+        # both variants actually consumed loss values every cycle
+        assert sync_reader.seen >= self.REPEATS
+        assert lagged_reader.seen > 0
+        assert gap_async < gap_sync, (
+            f"async gap {gap_async:.3f}ms not below sync {gap_sync:.3f}ms"
+        )
+        # CPU-proxy threshold: the async gap is pure host dispatch — it
+        # must sit well under the device-wait-dominated sync gap, not
+        # merely shave a sliver off it.
+        assert gap_async < 0.5 * gap_sync + 0.3, (
+            f"async gap {gap_async:.3f}ms vs sync {gap_sync:.3f}ms"
+        )
+
+    def test_lag_machinery_overhead_under_5pct(self, devices):
+        import jax
+        import numpy as np
+
+        def cycle_times(lag, trials=5):
+            looper, model, attrs = self._build(lag, None)
+            looper.launch(attrs)  # warmup cycle (compiles)
+            looper.reset(attrs)
+            jax.block_until_ready(model.state.params)
+            out = []
+            for _ in range(trials):
+                t0 = time.perf_counter()
+                looper.launch(attrs)
+                jax.block_until_ready(model.state.params)
+                out.append(time.perf_counter() - t0)
+                looper.reset(attrs)
+            return out
+
+        bare = float(np.median(cycle_times(0))) / self.REPEATS
+        armed = float(np.median(cycle_times(2))) / self.REPEATS
+        assert armed <= bare * 1.05 + 5e-4, (
+            f"lagged iter {armed * 1e3:.3f}ms vs sync {bare * 1e3:.3f}ms"
+        )
