@@ -52,6 +52,10 @@ _WORKER_STATE: dict = {}
 _WORKER_TOKEN_LOCK = threading.Lock()
 _WORKER_TOKEN_COUNTER = [0]
 
+# "No sharding passed" marker for DataLoader._to_device — distinct from
+# None, which is a RESOLVED value meaning "keep the batch on host".
+_UNRESOLVED = object()
+
 
 def _wrap_batch(batch: Any, valid: np.ndarray, mask_key: str) -> Any:
     """Collated batch -> Attributes with the validity mask (the ONE
@@ -267,8 +271,15 @@ class DataLoader:
 
         return batch_sharding(mesh, ndim=1)
 
-    def _to_device(self, host_batch: Any, sharding: Optional[Any] = None) -> Any:
-        sharding = sharding if sharding is not None else self._resolve_sharding()
+    def _to_device(self, host_batch: Any, sharding: Any = _UNRESOLVED) -> Any:
+        # Sentinel default: ``None`` is a real resolved value ("stay on
+        # host"), so a caller that resolved the epoch's sharding passes it
+        # through verbatim — only an unadorned call resolves against the
+        # mesh active right now.  Without the sentinel, an epoch that
+        # resolved to host would re-resolve per batch and a mesh_context
+        # opened mid-epoch would silently flip later batches onto devices.
+        if sharding is _UNRESOLVED:
+            sharding = self._resolve_sharding()
         if sharding is None:
             return host_batch
 
@@ -417,12 +428,12 @@ class DataLoader:
 
         sharding = self._resolve_sharding()
         depth = self.device_prefetch
-        if depth <= 0:
-            for host_batch in host_iter:
-                yield self._to_device(host_batch, sharding)
-            return
         staged: deque = deque()
         try:
+            if depth <= 0:
+                for host_batch in host_iter:
+                    yield self._to_device(host_batch, sharding)
+                return
             for host_batch in host_iter:
                 staged.append(self._to_device(host_batch, sharding))
                 if len(staged) > depth:

@@ -33,7 +33,11 @@ host floats are published as ``attrs.looper.lagged_logs`` for observers
 (Throughput credits completed steps off it; the status bar formats it) so
 nothing calls ``block_until_ready`` mid-epoch — syncs happen only at epoch
 boundaries (cycle reset), checkpoint points (the save's D2H copy), and stop
-votes.  The per-iteration **host dispatch gap** (host time spent outside
+votes.  At cycle reset the window is *drained*, not dropped: the
+not-yet-consumed tail is materialized (free — the boundary is a sync
+point) and published as ``attrs.looper.drained_logs`` so the final k
+steps' logs reach observers, and Throughput credits its remaining
+in-flight steps off it instead of under-counting k steps per cycle.  The per-iteration **host dispatch gap** (host time spent outside
 the backpressure wait — the time the chip could sit idle between steps) is
 measured every iteration and exposed as :attr:`Looper.last_dispatch_gap_ms`
 for the bench ladder and the async-loop regression guard.
@@ -108,12 +112,18 @@ class _LagWindow:
             return None
         return self._materialize(self._window.popleft())
 
-    def clear(self) -> None:
-        """Epoch-boundary / stop-vote sync point: drop the in-flight tail.
-        The staged buffers may be donated away between cycles — holding
-        them across the boundary would read freed storage (the same reason
-        the sentinel drops its staged scalars at ``reset``)."""
-        self._window.clear()
+    def drain(self) -> list:
+        """Epoch-boundary / stop-vote sync point: materialize every
+        remaining snapshot (oldest first) and empty the window.  Blocking
+        here is free — the caller drains only at a declared sync boundary,
+        where the device is waited on anyway — and the window must not
+        survive the boundary: the staged buffers may be donated away by
+        the next cycle's first step (the same reason the sentinel drops
+        its staged scalars at ``reset``)."""
+        out = []
+        while self._window:
+            out.append(self._materialize(self._window.popleft()))
+        return out
 
 
 class Looper(Dispatcher):
@@ -227,6 +237,7 @@ class Looper(Dispatcher):
             # read the lag and, per iteration, the k-lagged host floats.
             readback_lag=self._readback_lag,
             lagged_logs=None,
+            drained_logs=None,
         )
         self._lag_window = (
             _LagWindow(self._readback_lag) if self._readback_lag > 0 else None
@@ -239,12 +250,26 @@ class Looper(Dispatcher):
     def reset(self, attrs: Optional[Attributes] = None) -> None:
         if attrs is None or attrs.looper is None:
             return
+        looper = attrs.looper
+        if self._lag_window is not None:
+            # Cycle-end sync point: drain the in-flight readback tail and
+            # publish it BEFORE dispatching children's reset, so the final
+            # steps' logs reach observers (Throughput credits the remaining
+            # in-flight steps off it; trackers see the last losses) instead
+            # of vanishing with the window.  The tail is the final
+            # iteration's popped snapshot — published after the last
+            # dispatch, so no launch ever consumed it — followed by the
+            # window's remaining entries, oldest first; it is moved out of
+            # ``lagged_logs`` so a reset-time consumer can't double-count.
+            drained = []
+            if looper.get("lagged_logs") is not None:
+                drained.append(looper.lagged_logs)
+                looper.lagged_logs = None
+            drained += self._lag_window.drain()
+            looper.drained_logs = drained or None
         super().reset(attrs)
         del attrs.looper
         self._iter_idx = 0
-        # Epoch-boundary sync point: drop the in-flight readback tail.
-        if self._lag_window is not None:
-            self._lag_window.clear()
         self._lagged_state = None
 
     @property

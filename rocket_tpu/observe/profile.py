@@ -133,7 +133,10 @@ class Throughput(Capsule):
     lagged_logs`` lands — proof one more step actually completed — and the
     rate credits exactly that step's samples over the time since the
     previous readback.  Pipeline-fill dispatches therefore never inflate
-    samples/sec, and nothing here syncs the device either way.
+    samples/sec, and nothing here syncs the device either way.  At cycle
+    end the Looper drains its window into ``looper.drained_logs``; the
+    steps still in flight are credited off it at ``reset`` so the count
+    never silently drops the last k steps of a cycle.
     """
 
     def __init__(
@@ -234,6 +237,20 @@ class Throughput(Capsule):
             self._record(attrs)
 
     def reset(self, attrs: Optional[Attributes] = None) -> None:
+        looper = attrs.looper if attrs is not None else None
+        drained = looper.get("drained_logs") if looper is not None else None
+        if drained and self._inflight and self._last_time is not None:
+            # Lag-mode cycle end: the Looper drained its readback window,
+            # so the remaining in-flight steps are known complete — credit
+            # their samples over the time since the last readback instead
+            # of dropping them (which under-counted k steps every cycle).
+            now = self._clock()
+            size = 0
+            for _ in range(min(len(drained), len(self._inflight))):
+                size += self._inflight.popleft()
+            if size and now > self._last_time:
+                self._observe(attrs, looper, size, now - self._last_time)
+            self._last_time = now
         # Cycle end: flush the sub-``log_every`` remainder so short loops
         # (repeats < log_every) still produce at least one throughput
         # scalar instead of none (ISSUE 4 satellite).

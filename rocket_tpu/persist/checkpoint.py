@@ -244,6 +244,10 @@ class Checkpointer(Capsule):
 
     def destroy(self, attrs: Optional[Attributes] = None) -> None:
         default_io().wait()  # make the last snapshot durable
+        # The wait above proved the newest snapshot durable, so the
+        # surplus dir retained as crash insurance during in-flight saves
+        # (save() prunes before appending) can go now.
+        self._prune()
         if self._installed_handler:
             signal.signal(
                 signal.SIGTERM, _PREV_HANDLER.get("handler") or signal.SIG_DFL
@@ -272,17 +276,21 @@ class Checkpointer(Capsule):
         manifest = integrity.build_manifest(
             items, iter_idx=self._iter_idx, epoch_idx=self._epoch_idx,
         )
-        # Prune BEFORE issuing the new async save: _prune() must wait() out
-        # any in-flight write before deleting around it, and done in this
-        # order that wait drains the PREVIOUS save (long since overlapped
-        # with compute) instead of the one about to be issued — pruning
-        # after would synchronously drain the new save every time retention
-        # is active, killing the save/compute overlap.  Retention across
-        # restarts comes from the setup() disk scan, not from persisting
-        # this list.
+        # Prune BEFORE appending the new path, so retention counts only
+        # already-issued saves: the newest tracked entry always exists on
+        # disk, and keep_last DURABLE snapshots survive even if the async
+        # write issued below crashes mid-flight (append-then-prune would
+        # rmtree the only durable snapshot around the not-yet-written one).
+        # Disk transiently holds keep_last+1 dirs while a save is in
+        # flight; destroy() prunes the surplus once the final save is
+        # durable.  This order also preserves the save/compute overlap:
+        # _prune()'s wait() drains the PREVIOUS save (long since overlapped
+        # with compute), never the one about to be issued.  Retention
+        # across restarts comes from the setup() disk scan, not from
+        # persisting this list.
         if track:
-            self._saved_dirs.append(path)
             self._prune()
+            self._saved_dirs.append(path)
         default_io().save(path, items, force=True, manifest=manifest)
         self._logger.info("checkpoint -> %s", path)
         return path

@@ -157,6 +157,40 @@ def test_lagged_logs_trail_sync_series(tmp_path, devices):
     assert lagged.losses == rec_ref.losses[: 8 - lag - 1]
 
 
+def test_cycle_end_drain_delivers_final_losses(tmp_path, devices):
+    """The snapshots still in the lag window at cycle end reach observers
+    via ``looper.drained_logs`` (published before children reset) — the
+    launch-time lagged series plus the drained tail is exactly the full
+    sync loss series, nothing vanishes with the window."""
+
+    class DrainRecorder(LaggedRecorder):
+        def __init__(self):
+            super().__init__()
+            self.drained = []
+
+        def reset(self, attrs=None):
+            if attrs is None or attrs.looper is None:
+                return
+            for snap in attrs.looper.get("drained_logs") or ():
+                loss = snap.get("loss")
+                if loss is not None:
+                    self.drained.append(float(loss))
+
+    lag = 2
+    data = synthetic_classification(n=512)  # 8 iters/epoch at bs 64
+    ref, _, rec_ref = _tree(tmp_path, data, tag="drain-ref", epochs=1)
+    ref.launch()
+    assert len(rec_ref.losses) == 8
+
+    obs = DrainRecorder()
+    run, _, rec = _tree(tmp_path, data, tag="drain-obs", epochs=1, lag=lag,
+                        depth=1, extra=[obs])
+    run.launch()
+    assert rec.losses == rec_ref.losses
+    assert obs.losses == rec_ref.losses[: 8 - lag - 1]
+    assert obs.losses + obs.drained == rec_ref.losses
+
+
 @pytest.mark.resilience
 def test_sigterm_midflight_commits_and_resumes(tmp_path, devices):
     """Chaos: SIGTERM mid-epoch with up to k steps in flight still commits
@@ -323,6 +357,25 @@ class TestThroughputLagMode:
         assert tp._ema is None
         tp.launch(attrs)  # t=1: 8 samples / 1s
         assert tp._ema == pytest.approx(8.0)
+
+    def test_cycle_end_drain_credits_inflight(self):
+        """Cycle end: the Looper publishes the drained window; the steps
+        still in flight are credited off it instead of being dropped
+        (which silently under-counted k steps of samples every cycle)."""
+        from rocket_tpu.observe.profile import Throughput
+
+        times = iter([0.0, 10.0, 20.0, 30.0])
+        tp = Throughput(ema=0.5, log_every=1000, clock=lambda: next(times))
+        attrs = self._attrs(lag=2)
+        tp.set(attrs)
+        tp.launch(attrs)  # t=0: window opens
+        tp.launch(attrs)  # t=10
+        tp.launch(attrs)  # t=20 — nothing read back yet
+        assert tp._ema is None and len(tp._inflight) == 3
+        attrs.looper.drained_logs = [rt.Attributes(loss=0.1)] * 3
+        tp.reset(attrs)  # t=30: 3 completed steps -> 24 samples / 30s
+        assert len(tp._inflight) == 0
+        assert tp._ema == pytest.approx(24 / 30.0)
 
     def test_cycle_reset_clears_inflight(self):
         from rocket_tpu.observe.profile import Throughput

@@ -243,6 +243,58 @@ def test_retention_keep_last_survives_restart(tmp_path, devices):
     assert len(remaining) == 2, remaining
 
 
+def test_retention_prunes_only_issued_saves(tmp_path, devices):
+    """Crash-safety ordering: when a save is issued, the previous snapshot
+    must still be on disk — with ``keep_last=1`` it is the ONLY durable
+    state if the in-flight async write never completes.  (Append-then-prune
+    used to rmtree it before the new save was even enqueued.)  ``destroy``
+    then prunes the surplus once the final save is durable."""
+    data = synthetic_classification(n=256)
+    weights = tmp_path / "pred" / "v0" / "weights"
+
+    class Probe(rt.Capsule):
+        """Priority 50 < Checkpointer's 100: runs right after each save."""
+
+        def __init__(self):
+            super().__init__(statefull=False, priority=50)
+            self.iter = 0
+            self.missing = []
+
+        def launch(self, attrs=None):
+            self.iter += 1
+            # saves issue at iters 2,4,6,8 (save_every=2); from the second
+            # save on, the predecessor snapshot must have survived the
+            # prune that ran as this iteration's save was issued
+            expect = {4: "000001", 6: "000003", 8: "000005"}.get(self.iter)
+            if expect and not (weights / expect).is_dir():
+                self.missing.append(expect)
+
+    probe = Probe()
+    looper = rt.Looper(
+        capsules=[
+            rt.Dataset(rt.ArraySource(data), batch_size=64, shuffle=True,
+                       seed=7),
+            rt.Module(
+                MLP(),
+                capsules=[
+                    rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                    rt.Optimizer(learning_rate=2e-2),
+                ],
+            ),
+            rt.Checkpointer(save_every=2, keep_last=1),
+            probe,
+        ],
+        progress=False,
+    )
+    rt.Launcher(
+        capsules=[looper], tag="pred", num_epochs=2,
+        project_root=str(tmp_path),
+    ).launch()
+    assert probe.missing == []
+    # destroy() pruned the in-flight surplus down to keep_last
+    assert sorted(p.name for p in weights.iterdir()) == ["000007"]
+
+
 def test_preemption_sigterm_saves_and_resumes(tmp_path, devices):
     """SIGTERM mid-epoch: the Checkpointer writes a durable snapshot at the
     next iteration boundary, terminates the loop inside the grace window,

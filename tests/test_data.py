@@ -190,6 +190,38 @@ class TestDevicePrefetch:
         leaked = [t for t in set(threading.enumerate()) - before if t.is_alive()]
         assert not leaked
 
+    def test_depth0_early_exit_closes_upstream(self):
+        """``device_prefetch=0`` shares the cleanup contract of the staged
+        path: abandoning the epoch mid-way must still close the upstream
+        prefetch thread instead of deferring shutdown to GC."""
+        import threading
+
+        loader = DataLoader(_source(64), batch_size=4, prefetch=3,
+                            device_prefetch=0)
+        before = set(threading.enumerate())
+        it = loader.iterate()
+        next(it)
+        it.close()
+        leaked = [t for t in set(threading.enumerate()) - before if t.is_alive()]
+        assert not leaked
+
+    def test_placement_fixed_across_mid_epoch_mesh_change(self, devices):
+        """The batch sharding resolves ONCE per epoch: a ``mesh_context``
+        opened after the epoch started (resolved to host) must not flip
+        later batches onto devices mid-epoch."""
+        import jax
+
+        from rocket_tpu.parallel.context import mesh_context
+        from rocket_tpu.parallel.mesh import data_parallel_mesh
+
+        loader = DataLoader(_source(32), batch_size=8, device_prefetch=0)
+        it = loader.iterate()
+        first = next(it)
+        assert not isinstance(first["x"], jax.Array)  # no mesh at epoch start
+        with mesh_context(data_parallel_mesh()):
+            second = next(it)  # mesh opened mid-epoch: placement unchanged
+        assert not isinstance(second["x"], jax.Array)
+
     def test_to_device_honors_active_mesh(self, devices):
         """No explicit sharding wired in: inside a ``mesh_context`` the
         loader assembles global arrays laid out over the data axes; with no
