@@ -56,6 +56,18 @@ ApplyFn = Callable[[Any, Any, jax.Array, Any, bool], Tuple[Any, Any]]
 ObjectiveFn = Callable[[Any], Any]
 
 
+def _resolve_donate(donate: Optional[bool]) -> bool:
+    """``donate=None`` means "auto": consult the persisted autotune record
+    for this host's device/backend (``rocket_tpu.tune.store``), falling
+    back to the historical default of True.  Lazy import — engine.step is
+    imported by everything and must not pull the tune store eagerly."""
+    if donate is not None:
+        return bool(donate)
+    from rocket_tpu.tune.store import runtime_default
+
+    return bool(runtime_default("donate", default=True))
+
+
 class _AnnotatedStep:
     """Wrap a jitted step so each invocation runs inside a named
     ``jax.profiler`` annotation (ISSUE 4: dispatch vs host-fetch
@@ -148,7 +160,7 @@ def build_train_step(
     policy: Policy = Policy(),
     gradient_accumulation_steps: int = 1,
     log_grad_norm: bool = True,
-    donate: bool = True,
+    donate: Optional[bool] = True,
     skip_nonfinite: bool = False,
 ) -> Dict[str, Callable[[TrainState, Any], Tuple[TrainState, Dict[str, Any]]]]:
     """Build the jitted training step(s).
@@ -183,7 +195,9 @@ def build_train_step(
     saves are safe because Orbax's D2H snapshot completes before ``save``
     returns.  ``donate=False`` (or ``Runtime(donate_train_state=False)``)
     is the escape hatch for callers that must keep consecutive states
-    alive at once.
+    alive at once.  ``donate=None`` resolves from the persisted autotune
+    record (``rocket_tpu.tune.store.runtime_default("donate")``), True
+    when no record exists.
     """
     if gradient_accumulation_steps < 1:
         raise ValueError("gradient_accumulation_steps must be >= 1")
@@ -280,7 +294,7 @@ def build_train_step(
             replacements["micro"] = jnp.zeros((), dtype=jnp.int32)
         return state.replace(**replacements), logs
 
-    donate_argnums = (0,) if donate else ()
+    donate_argnums = (0,) if _resolve_donate(donate) else ()
     steps = {"sync": _annotated_dispatch(
         jax.jit(sync_step, donate_argnums=donate_argnums),
         "train_step/dispatch/sync",
@@ -300,7 +314,7 @@ def build_window_step(
     policy: Policy = Policy(),
     window: int = 1,
     log_grad_norm: bool = True,
-    donate: bool = True,
+    donate: Optional[bool] = True,
 ) -> Callable[[TrainState, Tuple[Any, ...]], Tuple[TrainState, Dict[str, Any]]]:
     """Fused gradient-accumulation step: ONE jitted call consumes the whole
     ``window``-batch accumulation window, concatenated on the batch dim,
@@ -398,7 +412,7 @@ def build_window_step(
             logs,
         )
 
-    donate_argnums = (0,) if donate else ()
+    donate_argnums = (0,) if _resolve_donate(donate) else ()
     return _annotated_dispatch(
         jax.jit(window_step, donate_argnums=donate_argnums),
         "train_step/dispatch/window",

@@ -987,7 +987,8 @@ class ContinuousBatcher:
 
     def __init__(self, model, draft_model, params, draft_params, *,
                  total_len, n_draft=4, eos_token=None, sampled=False,
-                 temperature=0.0, top_k=None, top_p=None, rng=None):
+                 temperature=0.0, top_k=None, top_p=None, rng=None,
+                 kv_cache_int8=None):
         import dataclasses
 
         if n_draft < 1:
@@ -1012,11 +1013,18 @@ class ContinuousBatcher:
                     f"decode_rolling_slack "
                     f"({m.config.decode_rolling_slack})"
                 )
+        # ``kv_cache_int8=None`` inherits each model config's setting;
+        # True/False overrides both models — the serve-layer knob
+        # (ServingLoop forwards it) without touching user configs.
+        overrides = {"decode_per_row": True}
+        if kv_cache_int8 is not None:
+            overrides["kv_cache_int8"] = bool(kv_cache_int8)
         per_row = lambda m: type(m)(  # noqa: E731
-            dataclasses.replace(m.config, decode_per_row=True)
+            dataclasses.replace(m.config, **overrides)
         )
         self._model = per_row(model)
         self._draft_model = per_row(draft_model)
+        self._base_models = (model, draft_model)  # for set_kv_cache_int8
         self._params = params
         self._draft_params = draft_params
         self.total_len = int(total_len)
@@ -1031,6 +1039,32 @@ class ContinuousBatcher:
         self._rng = rng if rng is not None else jax.random.PRNGKey(0)
         self._admits = 0
         self.state = None
+
+    def set_kv_cache_int8(self, enabled: bool) -> None:
+        """Flip the int8 KV-cache knob on both decode models.
+
+        Only valid BEFORE :meth:`start` (or after the batch drained and
+        before the next ``start``): a live device cache has a fixed
+        dtype/leaf layout, and re-laying it mid-flight would discard
+        every row's KV state.
+        """
+        import dataclasses
+
+        if self.state is not None:
+            raise ValueError(
+                "set_kv_cache_int8 after start(): the live cache layout "
+                "is fixed — drain the batch (or build a new batcher) "
+                "before changing it"
+            )
+        model, draft_model = self._base_models
+        rebuilt = lambda m: type(m)(  # noqa: E731
+            dataclasses.replace(
+                m.config, decode_per_row=True,
+                kv_cache_int8=bool(enabled),
+            )
+        )
+        self._model = rebuilt(model)
+        self._draft_model = rebuilt(draft_model)
 
     def _kw(self):
         return dict(eos_token=self.eos_token, sampled=self.sampled,

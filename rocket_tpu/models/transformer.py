@@ -89,6 +89,17 @@ class TransformerConfig:
     # a weights_int8 model is rejected by the Module (int8 leaves are not
     # trainable).
     weights_int8: bool = False
+    # Int8 KV cache for decode (ops.quant.quantize_kv_page): cache pages
+    # are stored int8 with a per-(row, slot, kv-head) f32 scale, halving
+    # the bytes the bandwidth-bound decode loop re-reads per token (the
+    # MBU denominator in bench_gpt2_decode shrinks accordingly).  Keys
+    # and values are quantized on cache WRITE and dequantized to the
+    # query dtype on read, so attention math is unchanged bf16; the
+    # scale rides the cache as a rank-4 ``[B, slots, KV, 1]`` leaf, so
+    # every cache-shuffling caller (beam gather, speculative admit,
+    # batched retire/admit) handles it exactly like the K/V payload.
+    # Orthogonal to weights_int8; composes with rolling + per-row caches.
+    kv_cache_int8: bool = False
     # Logits-free LM loss: emit per-token NLL (``batch['token_nll']``,
     # consumed by objectives.lm_cross_entropy) straight from the tied
     # embedding table via ops.fused_ce — the [B*S, vocab] logits tensor
@@ -395,12 +406,29 @@ class Attention(nn.Module):
             cfg.attention_window + cfg.decode_rolling_slack
             if cfg.decode_rolling_cache else cfg.max_seq
         )
+        quant = cfg.kv_cache_int8
         cached_k = self.variable(
-            "cache", "cached_k", jnp.zeros, (B, n_slots, KV, D), k.dtype
+            "cache", "cached_k", jnp.zeros, (B, n_slots, KV, D),
+            jnp.int8 if quant else k.dtype,
         )
         cached_v = self.variable(
-            "cache", "cached_v", jnp.zeros, (B, n_slots, KV, D), v.dtype
+            "cache", "cached_v", jnp.zeros, (B, n_slots, KV, D),
+            jnp.int8 if quant else v.dtype,
         )
+        if quant:
+            # Scales are RANK-4 on purpose: the decode callers that
+            # shuffle cache rows (beam gather/tile, speculative admit)
+            # discriminate K/V payload leaves from the scalar
+            # cache_index by ndim == 4 — scale leaves ride the same
+            # code paths with zero changes there.
+            k_scale = self.variable(
+                "cache", "cached_k_scale", jnp.zeros,
+                (B, n_slots, KV, 1), jnp.float32,
+            )
+            v_scale = self.variable(
+                "cache", "cached_v_scale", jnp.zeros,
+                (B, n_slots, KV, 1), jnp.float32,
+            )
         cache_index = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
@@ -410,6 +438,35 @@ class Attention(nn.Module):
             # must see the same masking as every other path)
             return attend(q, k, v, impl="dot", causal=cfg.causal,
                           window=cfg.attention_window)
+        if quant:
+            from rocket_tpu.ops.quant import (
+                dequantize_kv_page,
+                quantize_kv_page,
+            )
+
+            k_q, k_s = quantize_kv_page(k)
+            v_q, v_s = quantize_kv_page(v)
+            writes = [(cached_k, k_q), (cached_v, v_q),
+                      (k_scale, k_s), (v_scale, v_s)]
+        else:
+            writes = [(cached_k, k), (cached_v, v)]
+
+        def write_all(write_fn):
+            # Apply one write op uniformly to every cache leaf (payload
+            # AND scales — identical leading dims, so slot indexing is
+            # shared), then return the full dequantized K/V to attend
+            # against.  Dequant of the WRITTEN cache (not the inputs)
+            # keeps the attended values bit-identical to what a later
+            # step will read back — the quantization error is paid once,
+            # at write time, consistently.
+            new = [write_fn(var.value, upd) for var, upd in writes]
+            for (var, _), nv in zip(writes, new):
+                var.value = nv
+            if quant:
+                return (dequantize_kv_page(new[0], new[2], q.dtype),
+                        dequantize_kv_page(new[1], new[3], q.dtype))
+            return new[0], new[1]
+
         idx = cache_index.value
         if cfg.decode_rolling_cache:
             if S > cfg.decode_rolling_slack:
@@ -424,10 +481,9 @@ class Attention(nn.Module):
                 starts[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
             ) % n_slots                                    # [B, S], unique
             row_scatter = jax.vmap(lambda c, u, sl: c.at[sl].set(u))
-            k_all = row_scatter(cached_k.value, k, slots)
-            v_all = row_scatter(cached_v.value, v, slots)
-            cached_k.value = k_all
-            cached_v.value = v_all
+            k_all, v_all = write_all(
+                lambda c, u: row_scatter(c, u, slots)
+            )
             cache_index.value = jnp.max(starts) + S
             # Implied position per slot: the largest position <= this
             # chunk's end congruent to the slot index.  A slot whose
@@ -448,23 +504,21 @@ class Attention(nn.Module):
             row_write = jax.vmap(
                 lambda c, u, s: jax.lax.dynamic_update_slice(c, u, (s, 0, 0))
             )
-            k_all = row_write(cached_k.value, k, starts)
-            v_all = row_write(cached_v.value, v, starts)
+            k_all, v_all = write_all(
+                lambda c, u: row_write(c, u, starts)
+            )
             q_off = starts
             # scalar cache_index is bookkeeping only in this mode (rows
             # advance independently); track the furthest write frontier
             cache_index.value = jnp.max(starts) + S
         else:
-            k_all = jax.lax.dynamic_update_slice(
-                cached_k.value, k, (0, idx, 0, 0)
-            )
-            v_all = jax.lax.dynamic_update_slice(
-                cached_v.value, v, (0, idx, 0, 0)
+            k_all, v_all = write_all(
+                lambda c, u: jax.lax.dynamic_update_slice(
+                    c, u, (0, idx, 0, 0)
+                )
             )
             q_off = idx
             cache_index.value = idx + S
-        cached_k.value = k_all
-        cached_v.value = v_all
         return dot_attention(q, k_all, v_all, causal=True, q_offset=q_off,
                              window=cfg.attention_window)
 

@@ -290,6 +290,13 @@ def span(name: str, **fields: Any):
     return _GLOBAL.span(name, **fields)
 
 
+def counter(name: str, value: float = 1, **fields: Any) -> None:
+    """Record a counter sample on the global tracer (no-op unless armed).
+    The convenience for library code that wants one line, not a
+    ``get_tracer()`` dance — e.g. ``ops.quant``'s fallback telemetry."""
+    _GLOBAL.counter(name, value, **fields)
+
+
 # -- latency histograms -----------------------------------------------------
 
 

@@ -25,6 +25,7 @@ TPU-native addition in the spirit of its extensibility goals.
 from __future__ import annotations
 
 import functools
+import warnings
 from typing import Any, Tuple
 
 import jax
@@ -34,6 +35,42 @@ from jax.experimental import pallas as pl
 
 def _interpret() -> bool:
     return jax.default_backend() != "tpu"
+
+
+# One warning per process, not per trace: the fallback is a *performance*
+# surprise (full-width dequant defeats the int8 bandwidth win), not an
+# error, and decode loops retrace on shape buckets.
+_warned_fallback = False
+
+
+def _note_fallback(reason: str, M: int, K: int, N: int,
+                   remediable: bool = True) -> None:
+    """Record an ``int8_matmul`` dequant-einsum fallback.
+
+    Runs at TRACE time (the routing branch is static on shapes), so the
+    tracing counter counts compiled programs that contain the fallback —
+    exactly the unit that matters, since within one program the cost
+    recurs every execution.  The ``warnings.warn`` is one-shot per
+    process and only fires for the *remediable* case (misaligned K, fixed
+    by padding); large-M routing is by design and only counted.
+    """
+    from rocket_tpu.observe.trace import counter
+
+    counter("quant.int8_matmul.fallback", 1, reason=reason, M=M, K=K, N=N)
+    global _warned_fallback
+    if _warned_fallback or not remediable:
+        return
+    _warned_fallback = True
+    warnings.warn(
+        f"int8_matmul fell back to dequant-einsum ({reason}; M={M}, "
+        f"K={K}, N={N}): the full weight matrix is dequantized to "
+        f"activation width, so the int8 HBM bandwidth saving is lost "
+        f"for this matmul. Remedy: pad the "
+        f"contraction dim to a multiple of 128 (e.g. vocab 50257 -> "
+        f"50304, as TransformerConfig.gpt2_124m does) so the pallas "
+        f"kernel can load full-K tiles.",
+        stacklevel=3,
+    )
 
 
 def quantize_int8(w: jax.Array, axis: int = 0) -> Tuple[jax.Array, jax.Array]:
@@ -139,6 +176,14 @@ def int8_matmul(x: jax.Array, q: jax.Array, scale: jax.Array, *,
     if small and aligned:
         out = _int8_matmul_kernel_call(x2, q, scale, nk_layout, block_n)
     else:
+        N = scale.shape[0]
+        if small and not aligned:
+            # Rows were decode-shaped — only the misaligned K forced the
+            # fallback, which is the fixable (padding) case worth flagging.
+            _note_fallback(f"K % 128 == {K % 128}", M, K, N)
+        else:
+            _note_fallback(f"M > KERNEL_MAX_ROWS ({M} > {KERNEL_MAX_ROWS})",
+                           M, K, N, remediable=False)
         w = dequantize_int8(
             q, scale, axis=1 if nk_layout else 0, dtype=x.dtype
         )
@@ -146,6 +191,33 @@ def int8_matmul(x: jax.Array, q: jax.Array, scale: jax.Array, *,
             w = w.T
         out = x2 @ w
     return out.reshape(*lead, out.shape[-1])
+
+
+def quantize_kv_page(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Symmetric per-page int8 quantization for KV-cache writes.
+
+    A "page" is one head's feature vector at one cache slot: the amax is
+    taken over the LAST axis (head dim) with ``keepdims=True``, so for a
+    ``[..., KV, D]`` key/value tensor the scale is ``[..., KV, 1]`` f32 —
+    rank-preserving, which lets the scale ride the cache through every
+    slot-indexed scatter/gather exactly like the int8 payload (the decode
+    batcher's rank-4 cache-leaf discrimination sees both identically).
+    Returns ``(q int8, scale f32 [..., 1])``.  All-zero pages quantize to
+    zeros with scale 0 (the zero-scale guard keeps the divide finite and
+    the dequant exact).
+    """
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+    scale = amax / 127.0
+    safe = jnp.where(scale == 0.0, 1.0, scale)
+    q = jnp.clip(jnp.round(xf / safe), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_kv_page(q: jax.Array, scale: jax.Array,
+                       dtype: Any = jnp.bfloat16) -> jax.Array:
+    """Inverse of :func:`quantize_kv_page` (scale broadcasts over D)."""
+    return (q.astype(jnp.float32) * scale).astype(dtype)
 
 
 def quantize_params(params: Any) -> Any:

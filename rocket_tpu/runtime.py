@@ -53,7 +53,7 @@ class Runtime:
         seed: int = 0,
         tracing: bool = False,
         trace_capacity: int = 4096,
-        donate_train_state: bool = True,
+        donate_train_state: Optional[bool] = None,
     ) -> None:
         if mesh is None:
             mesh = data_parallel_mesh()
@@ -96,8 +96,14 @@ class Runtime:
         self.skip_nonfinite_updates = False
         # Run-level escape hatch for train-state buffer donation: Modules
         # that were not given an explicit ``donate=`` resolve it from here
-        # at step-build time (engine.step donate_argnums).
-        self.donate_train_state = bool(donate_train_state)
+        # at step-build time (engine.step donate_argnums).  None = "auto":
+        # a persisted autotune record's ``donate`` knob applies
+        # (rocket_tpu.tune.store.runtime_default), defaulting to True
+        # when no record exists — identical behavior to the old
+        # hardcoded True until a search has actually run.
+        self.donate_train_state = (
+            None if donate_train_state is None else bool(donate_train_state)
+        )
         # Pending resume request (set by Launcher.resume): Attributes with
         # ``path`` and ``load_capsules``.  Capsules with lazily-materialized
         # array state (Module) consume it at materialization time; host-scalar

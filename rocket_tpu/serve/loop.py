@@ -109,7 +109,9 @@ class ServingLoop:
     greedy lane.  ``sink`` is a tracker backend (``log_scalars``)
     receiving ``serve/*`` counters every ``flush_every`` rounds.
     ``clock`` is injectable for deterministic deadline tests; the
-    watchdog always uses real time.
+    watchdog always uses real time.  ``kv_cache_int8`` (None = defer to
+    the factory's model configs) forces the int8 KV-cache layout on or
+    off for every batcher the loop builds — including watchdog rebuilds.
     """
 
     def __init__(
@@ -128,10 +130,16 @@ class ServingLoop:
         tracer: Optional[Any] = None,
         recorder: Optional[Any] = None,
         logger: Optional[logging.Logger] = None,
+        kv_cache_int8: Optional[bool] = None,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
         self._factory = batcher_factory
+        # Serve-level int8 KV-cache knob: None defers to the factory's
+        # models; True/False overrides EVERY build — the initial batcher
+        # and any watchdog-recovery rebuild — via set_kv_cache_int8, so
+        # a recovery cannot silently drop the quantized layout.
+        self._kv_cache_int8 = kv_cache_int8
         self._max_batch = int(max_batch)
         self.queue = AdmissionQueue(queue_capacity)
         self.policy = policy if policy is not None else DegradationPolicy()
@@ -163,11 +171,18 @@ class ServingLoop:
         self._carry: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._compiled_drafts: set = set()
 
-        self._bat = batcher_factory()
+        self._bat = self._build_batcher()
         self.base_n_draft = int(self._bat.n_draft)
         self._warm_start(self._bat)
 
     # -- lifecycle -----------------------------------------------------
+
+    def _build_batcher(self) -> Any:
+        """Factory call + the loop-level knobs every build must carry."""
+        bat = self._factory()
+        if self._kv_cache_int8 is not None:
+            bat.set_kv_cache_int8(self._kv_cache_int8)
+        return bat
 
     def _warm_start(self, bat: Any) -> None:
         """Start the batcher on a dummy all-retired group and run one
@@ -482,7 +497,7 @@ class ServingLoop:
         fresh one.  The persistent ``_spec_round`` jit cache keys on
         structurally-hashed modules, so this does NOT retrace; the cost
         is one dummy prefill + round."""
-        self._bat = self._factory()
+        self._bat = self._build_batcher()
         self._bat.n_draft = self.policy.n_draft(self.base_n_draft)
         self._warm_start(self._bat)
         self._recover_in = self._recover_rounds

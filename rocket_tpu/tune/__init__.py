@@ -1,0 +1,42 @@
+"""Search-driven autotuner (ROADMAP item 5).
+
+The bench ladder measures; this package closes the loop:
+
+- :mod:`rocket_tpu.tune.space` — a declarative tune space (batch, flash
+  block sizes, remat policy, ``scan_layers``, ``fused_qkv``/``fused_ce``,
+  ``ce_chunk``, donation, prefetch depth, mesh layout);
+- :mod:`rocket_tpu.tune.cost_model` — an analytical roofline (FLOPs +
+  HBM bytes over device peaks, the same plumbing ``bench.py`` reports
+  MFU/MBU with) that RANKS candidates before anything is measured;
+- :mod:`rocket_tpu.tune.search` — cost-model-seeded successive halving
+  over short timed probes through ``bench.py``, each probe a fresh
+  subprocess so a bad point (miscompile, OOM, hang) cannot poison the
+  run;
+- :mod:`rocket_tpu.tune.store` — per-(model, device, batch, backend)
+  JSON records under ``experiments/tunes/`` with a :func:`best_tune`
+  lookup that ``bench.py``, ``Module``, and the engine step consult as
+  defaults — a completed search changes real runs with zero re-search.
+
+CLI: ``python -m rocket_tpu.tune --help``.
+"""
+
+from rocket_tpu.tune.cost_model import (  # noqa: F401
+    device_peak_flops,
+    device_peak_hbm_bytes,
+    gpt2_step_flops,
+    predict_point,
+)
+from rocket_tpu.tune.search import autotune, successive_halving  # noqa: F401
+from rocket_tpu.tune.space import (  # noqa: F401
+    TuneParam,
+    TuneSpace,
+    gpt2_space,
+)
+from rocket_tpu.tune.store import (  # noqa: F401
+    best_tune,
+    canonical_tune_key,
+    load_tunes,
+    runtime_default,
+    save_tune,
+    tune_dir,
+)

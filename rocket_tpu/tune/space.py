@@ -1,0 +1,125 @@
+"""Declarative tune spaces.
+
+A :class:`TuneSpace` is a tuple of named :class:`TuneParam` dimensions;
+each dimension's choices are DICT FRAGMENTS merged into a point, so one
+dimension can move several coupled knobs at once (flash ``block_q`` /
+``block_k`` travel as a pair — independent products would enumerate
+shapes the kernel never runs well).  ``{}`` as a choice means "library
+default" for that dimension.
+
+``probe=False`` marks advisory dimensions (prefetch depth, mesh layout):
+they are scored by the cost model and persisted in the tune record for
+the runtime consumers (``Module``, the data loader), but stripped from
+the dict handed to ``bench.bench_gpt2`` — the train-step probe cannot
+observe them, and an unknown key would be rejected there anyway.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, Iterator, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneParam:
+    """One search dimension: ``choices`` are dict fragments to merge."""
+
+    name: str
+    choices: Tuple[Dict[str, Any], ...]
+    probe: bool = True  # False: cost-model/record only, never benched
+
+    def __post_init__(self) -> None:
+        if not self.choices:
+            raise ValueError(f"tune param {self.name!r} has no choices")
+        for c in self.choices:
+            if not isinstance(c, dict):
+                raise ValueError(
+                    f"tune param {self.name!r}: choices must be dict "
+                    f"fragments, got {type(c).__name__}"
+                )
+
+
+@dataclasses.dataclass(frozen=True)
+class TuneSpace:
+    params: Tuple[TuneParam, ...]
+
+    def __post_init__(self) -> None:
+        names = [p.name for p in self.params]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate tune param names in {names}")
+
+    @property
+    def size(self) -> int:
+        n = 1
+        for p in self.params:
+            n *= len(p.choices)
+        return n
+
+    def candidates(self) -> Iterator[Dict[str, Any]]:
+        """Every point in the space, as one merged override dict.  Later
+        dimensions win key collisions — define coupled knobs in ONE
+        dimension instead of relying on that."""
+        for combo in itertools.product(*(p.choices for p in self.params)):
+            point: Dict[str, Any] = {}
+            for frag in combo:
+                point.update(frag)
+            yield point
+
+    def advisory_keys(self) -> set:
+        """Keys contributed only by ``probe=False`` dimensions."""
+        keys: set = set()
+        for p in self.params:
+            if not p.probe:
+                for frag in p.choices:
+                    keys.update(frag)
+        return keys
+
+    def bench_tune(self, point: Dict[str, Any]) -> Dict[str, Any]:
+        """The probe-visible subset of a point (advisory keys stripped)."""
+        drop = self.advisory_keys()
+        return {k: v for k, v in point.items() if k not in drop}
+
+
+def gpt2_space(tiny: bool = False) -> TuneSpace:
+    """The GPT-2 train-step space the CLI searches by default.
+
+    ``tiny=True`` shrinks it to a CPU-proxy space (2 points over a toy
+    model) — the tier-1 smoke test's shape: same machinery, seconds of
+    wall clock.
+    """
+    if tiny:
+        return TuneSpace(params=(
+            TuneParam("model", ({"hidden": 64, "n_layers": 2, "n_heads": 4,
+                                 "vocab": 256, "batch": 2, "seq": 64,
+                                 "attention": "dot"},)),
+            TuneParam("fusion", ({}, {"fused_qkv": True})),
+        ))
+    return TuneSpace(params=(
+        TuneParam("batch", ({"batch": 8}, {"batch": 16}, {"batch": 32})),
+        TuneParam("blocks", (
+            {},                                      # ops.flash.auto_blocks
+            {"block_q": 256, "block_k": 512},
+            {"block_q": 512, "block_k": 1024},
+        )),
+        TuneParam("fusion", (
+            {},
+            {"fused_qkv": True},
+            {"fused_ce": True},
+            {"fused_qkv": True, "fused_ce": True},
+        )),
+        TuneParam("ce_chunk", ({}, {"ce_chunk": 512})),
+        TuneParam("remat", (
+            {},
+            {"remat": True, "remat_policy": "dots"},
+            {"remat": True, "remat_policy": "nothing"},
+        )),
+        TuneParam("scan", ({}, {"scan_layers": True})),
+        TuneParam("mu", ({}, {"mu_dtype": "bf16"})),
+        TuneParam("donate", ({}, {"donate": False})),
+        # Advisory dimensions: consumed from the saved record by the
+        # runtime (loader device_prefetch depth; mesh axis layout for
+        # multi-chip runs), invisible to the single-chip train probe.
+        TuneParam("prefetch", ({}, {"prefetch": 2}), probe=False),
+        TuneParam("mesh", ({}, {"mesh": "fsdp"}), probe=False),
+    ))

@@ -1,0 +1,191 @@
+"""Persistent tune store — ``experiments/tunes/*.json``.
+
+One JSON file per (model, device, batch, backend) search result.  Record
+schema (``"schema": 1``, documented in docs/performance.md):
+
+```
+{
+  "schema": 1,
+  "model": "gpt2",              # search target
+  "device": "TPU v5 lite",      # jax device_kind the probes ran on
+  "backend": "tpu",             # jax.default_backend()
+  "batch": 16,                  # winning batch (part of the key)
+  "tune": {...},                # the winning point, advisory keys incl.
+  "value": 119600.0,            # measured tokens/sec of the winner
+  "mfu": 0.4587,                # measured MFU of the winner
+  "probes": 14,                 # subprocess probes the search spent
+  "rungs": [...],               # per-rung survivor summaries
+  "created": "2026-08-05T12:00:00Z"
+}
+```
+
+Ship a tune to another machine by copying the file — the lookup keys
+live IN the record, so the filename is a convenience, not a contract.
+:func:`best_tune` scores exact field matches over wildcards and breaks
+ties by recency, so a record measured on the same device kind wins over
+a generic one even after a rename.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import time
+from typing import Any, Dict, List, Optional
+
+SCHEMA_VERSION = 1
+
+_ENV_DIR = "ROCKET_TPU_TUNE_DIR"
+
+
+def tune_dir() -> str:
+    """The store directory: ``$ROCKET_TPU_TUNE_DIR`` if set, else the
+    repo's ``experiments/tunes/``."""
+    env = os.environ.get(_ENV_DIR)
+    if env:
+        return env
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, "experiments", "tunes")
+
+
+def _slug(s: Any) -> str:
+    return re.sub(r"[^a-z0-9]+", "-", str(s).lower()).strip("-") or "any"
+
+
+def record_path(model: str, device: str, batch: Any, backend: str) -> str:
+    name = f"{_slug(model)}-{_slug(device)}-b{_slug(batch)}-{_slug(backend)}"
+    return os.path.join(tune_dir(), name + ".json")
+
+
+def canonical_tune_key(tune: Dict[str, Any],
+                       defaults: Optional[Dict[str, Any]] = None) -> str:
+    """Stable string identity of a tune dict: defaults merged in, flash
+    block ``None`` resolved through the shape-aware
+    ``ops.flash.auto_blocks`` the model actually runs — an explicitly
+    measured 512/1024 at seq 1024 IS the library default ``None/None``,
+    and deduping on the canonical key stops the sweep (and the search)
+    from measuring the same executable twice under two names."""
+    eff = dict(defaults or {}, **(tune or {}))
+    seq = eff.get("seq")
+    if seq and (eff.get("block_q") is None or eff.get("block_k") is None):
+        from rocket_tpu.ops.flash import auto_blocks
+
+        bq, bk = auto_blocks(int(seq))
+        if eff.get("block_q") is None:
+            eff["block_q"] = bq
+        if eff.get("block_k") is None:
+            eff["block_k"] = bk
+    return json.dumps(eff, sort_keys=True, default=str)
+
+
+def save_tune(record: Dict[str, Any]) -> str:
+    """Write a tune record (atomically — a concurrent reader never sees a
+    torn file); returns the path."""
+    for field in ("model", "device", "backend", "tune"):
+        if field not in record:
+            raise ValueError(f"tune record missing required field {field!r}")
+    out = dict(record)
+    out.setdefault("schema", SCHEMA_VERSION)
+    out.setdefault("batch", out["tune"].get("batch"))
+    out.setdefault(
+        "created", time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    )
+    path = record_path(out["model"], out["device"], out["batch"],
+                       out["backend"])
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(out, fh, indent=2, sort_keys=True, default=str)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return path
+
+
+def load_tunes() -> List[Dict[str, Any]]:
+    """Every readable record in the store (unreadable files skipped —
+    the store must never break a run)."""
+    out = []
+    try:
+        names = sorted(os.listdir(tune_dir()))
+    except OSError:
+        return out
+    for name in names:
+        if not name.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(tune_dir(), name)) as fh:
+                rec = json.load(fh)
+        except (OSError, ValueError):
+            continue
+        if isinstance(rec, dict) and isinstance(rec.get("tune"), dict):
+            out.append(rec)
+    return out
+
+
+def _match_score(rec: Dict[str, Any], model: Optional[str],
+                 device: Optional[str], batch: Optional[int],
+                 backend: Optional[str]) -> Optional[int]:
+    """None = disqualified; otherwise count of exact field matches (a
+    requested field that DISAGREES disqualifies; an unrequested field is
+    a wildcard)."""
+    score = 0
+    for want, have in (
+        (model, rec.get("model")),
+        (backend, rec.get("backend")),
+        (device, rec.get("device")),
+    ):
+        if want is not None:
+            if _slug(want) != _slug(have):
+                return None
+            score += 1
+    if batch is not None:
+        if rec.get("batch") is not None and int(rec["batch"]) != int(batch):
+            return None
+        score += 1 if rec.get("batch") is not None else 0
+    return score
+
+
+def best_tune(model: Optional[str] = None, device: Optional[str] = None,
+              batch: Optional[int] = None,
+              backend: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """The best-matching tune record, or ``None``.
+
+    Requested fields must match exactly (slug-compared); omitted fields
+    are wildcards.  Among qualifiers, more exact matches win, then the
+    most recent ``created`` stamp.  Never raises — a broken store reads
+    as empty.
+    """
+    best_rec, best_key = None, None
+    for rec in load_tunes():
+        score = _match_score(rec, model, device, batch, backend)
+        if score is None:
+            continue
+        key = (score, str(rec.get("created", "")))
+        if best_key is None or key > best_key:
+            best_rec, best_key = rec, key
+    return best_rec
+
+
+def runtime_default(knob: str, default: Any = None,
+                    model: Optional[str] = None) -> Any:
+    """One knob from the best tune record for the LOCAL device/backend —
+    the hook ``Module`` / the engine step call at build time for
+    runtime-level knobs (``donate``, ``prefetch``).  Falls back to
+    ``default`` when no record (or no such knob) exists; never raises
+    and never touches the backend beyond reading its name."""
+    try:
+        import jax
+
+        rec = best_tune(model=model, backend=jax.default_backend())
+        if rec is None and model is not None:
+            rec = best_tune(backend=jax.default_backend())
+        if rec is None:
+            rec = best_tune(model=model)
+    except Exception:
+        return default
+    if rec is None:
+        return default
+    val = rec.get("tune", {}).get(knob, default)
+    return default if val is None else val

@@ -462,3 +462,78 @@ class TestAsyncLoopGuard:
         assert armed <= bare * 1.05 + 5e-4, (
             f"lagged iter {armed * 1e3:.3f}ms vs sync {bare * 1e3:.3f}ms"
         )
+
+
+# -- int8 KV-cache decode guard (autotuner ISSUE acceptance) ---------------
+#
+# The quantized cache's promise is BANDWIDTH, paid for with per-page
+# quantize/dequantize inside the same compiled step.  These guards pin the
+# two ways that deal can silently go bad on the host side: a shape or
+# dtype leak that makes the decode round retrace per emitted token, and
+# host-visible per-round overhead beyond the bf16-cache baseline.
+
+
+@pytest.mark.serving
+class TestQuantGuard:
+    B, P, TOTAL, NDRAFT = 2, 6, 20, 3
+
+    def _batcher(self, kv_cache_int8):
+        import jax
+        import numpy as np
+
+        from rocket_tpu.models.generate import ContinuousBatcher
+        from rocket_tpu.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+
+        cfg = TransformerConfig(
+            vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=64,
+        )
+        model = TransformerLM(cfg)
+        params = model.init(
+            jax.random.PRNGKey(1),
+            {"tokens": np.zeros((1, self.P), np.int32),
+             "positions": np.zeros((1, self.P), np.int32)},
+        )["params"]
+        bat = ContinuousBatcher(
+            model, model, params, params, total_len=self.TOTAL,
+            n_draft=self.NDRAFT, kv_cache_int8=kv_cache_int8,
+        )
+        prompts = np.random.default_rng(13).integers(
+            1, 64, size=(self.B, self.P)
+        ).astype(np.int32)
+        bat.start(prompts)
+        return bat
+
+    def test_zero_retraces_per_emitted_token(self, devices):
+        from rocket_tpu.models.generate import _spec_round
+
+        bat = self._batcher(kv_cache_int8=True)
+        bat.step()  # compile round 0 (admits no new shapes afterwards)
+        traces_after_warmup = _spec_round._cache_size()
+        for _ in range(6):
+            bat.step()
+        assert _spec_round._cache_size() == traces_after_warmup, (
+            "int8 KV decode retraced after warmup — a per-token shape or "
+            "dtype leak in the quantized cache plumbing"
+        )
+
+    def test_host_overhead_vs_bf16_cache_under_5pct(self, devices):
+        import numpy as np
+
+        def round_times(kv_cache_int8, rounds=8):
+            bat = self._batcher(kv_cache_int8)
+            bat.step()  # compile
+            out = []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                n_tok, done = bat.step()  # returns HOST arrays: synced
+                out.append(time.perf_counter() - t0)
+            return out
+
+        bare = float(np.median(round_times(False)))
+        quant = float(np.median(round_times(True)))
+        assert quant <= bare * 1.05 + 5e-4, (
+            f"int8 round {quant * 1e3:.3f}ms vs bf16 {bare * 1e3:.3f}ms"
+        )

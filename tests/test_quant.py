@@ -12,8 +12,10 @@ import pytest
 
 from rocket_tpu.ops.quant import (
     dequantize_int8,
+    dequantize_kv_page,
     int8_matmul,
     quantize_int8,
+    quantize_kv_page,
     quantize_params,
 )
 
@@ -300,6 +302,119 @@ def test_quantize_params_rejects_stacked_kernels(devices):
     w = jax.random.normal(jax.random.PRNGKey(0), (2, 16, 32))
     with pytest.raises(ValueError, match="scan_layers"):
         quantize_params({"blocks": {"mlp": {"kernel": w}}})
+
+
+def test_quantize_params_unboxes_partitioned_leaves(devices):
+    """A sharding-annotated checkpoint carries nn.Partitioned boxes;
+    quantize_params must unbox and QUANTIZE those kernels, not let the
+    box shield them into a silent f32 passthrough."""
+    import flax.linen as nn
+
+    w = jax.random.normal(jax.random.PRNGKey(0), (16, 32))
+    boxed = {"dense": {"kernel": nn.Partitioned(w, names=("embed", "mlp"))}}
+    got = quantize_params(boxed)
+    assert "kernel_q" in got["dense"] and "kernel_scale" in got["dense"]
+    assert got["dense"]["kernel_q"].dtype == jnp.int8
+    back = dequantize_int8(
+        got["dense"]["kernel_q"], got["dense"]["kernel_scale"],
+        axis=0, dtype=jnp.float32,
+    )
+    np.testing.assert_allclose(
+        np.asarray(back), np.asarray(w), rtol=2e-2, atol=2e-2
+    )
+
+
+def test_quantize_params_lora_adapters_pass_through(devices):
+    """LoRA adapter trees (lora_a/lora_b rank-2 leaves NOT named
+    'kernel') must pass through untouched — they are precision-critical
+    deltas, and quantize_params documents it leaves them alone."""
+    a = jax.random.normal(jax.random.PRNGKey(1), (16, 4))
+    b = jax.random.normal(jax.random.PRNGKey(2), (4, 32))
+    k = jax.random.normal(jax.random.PRNGKey(3), (16, 32))
+    tree = {"dense": {"kernel": k, "lora_a": a, "lora_b": b}}
+    got = quantize_params(tree)
+    assert "kernel_q" in got["dense"]
+    np.testing.assert_array_equal(
+        np.asarray(got["dense"]["lora_a"]), np.asarray(a)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(got["dense"]["lora_b"]), np.asarray(b)
+    )
+    assert got["dense"]["lora_a"].dtype == a.dtype
+
+
+def test_quantize_params_stacked_error_names_the_remedy(devices):
+    """The stacked-kernel rejection must tell the user WHAT to do —
+    re-export with scan_layers=False — not just that rank 3 is bad."""
+    w = jnp.zeros((2, 16, 32))
+    with pytest.raises(ValueError) as exc:
+        quantize_params({"blocks": {"mlp": {"kernel": w}}})
+    msg = str(exc.value)
+    assert "scan_layers=False" in msg and "rank 3" in msg
+
+
+def test_kv_page_quantize_roundtrip_and_shapes(devices):
+    """Per-page KV quantization: int8 payload + rank-preserving
+    [..., KV, 1] f32 scale, error within half a quantization step, and
+    all-zero pages dequantize to exact zeros."""
+    kv = jax.random.normal(jax.random.PRNGKey(4), (2, 5, 3, 16)) * 2.0
+    q, s = quantize_kv_page(kv)
+    assert q.dtype == jnp.int8 and q.shape == kv.shape
+    assert s.dtype == jnp.float32 and s.shape == (2, 5, 3, 1)
+    back = dequantize_kv_page(q, s, jnp.float32)
+    err = np.abs(np.asarray(kv, np.float32) - np.asarray(back))
+    bound = np.broadcast_to(np.asarray(s) * 0.5 + 1e-7, err.shape)
+    np.testing.assert_array_less(err, bound)
+    qz, sz = quantize_kv_page(jnp.zeros((1, 2, 2, 8)))
+    assert np.all(np.asarray(qz) == 0)
+    assert np.all(np.asarray(dequantize_kv_page(qz, sz)) == 0)
+
+
+def test_int8_matmul_fallback_warns_once_and_counts(devices):
+    """Satellite: a misaligned-K fallback warns ONCE per process (with
+    the padding remedy) and increments the tracing counter per trace;
+    the by-design large-M fallback is counted but never warns."""
+    import warnings
+
+    import rocket_tpu.ops.quant as quant_mod
+    from rocket_tpu.observe import trace
+
+    tracer = trace.arm(512)
+    try:
+        w = jax.random.normal(jax.random.PRNGKey(5), (100, 60))
+        q, s = quantize_int8(w, axis=0)
+        x = jnp.ones((2, 100), jnp.bfloat16)
+        quant_mod._warned_fallback = False
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            int8_matmul(x, q, s)
+            int8_matmul(x, q, s)  # second call: counter yes, warning no
+        msgs = [str(c.message) for c in caught
+                if "int8_matmul" in str(c.message)]
+        assert len(msgs) == 1, msgs
+        assert "multiple of 128" in msgs[0]  # the remedy
+        events = [e for e in tracer.events()
+                  if e[1] == "quant.int8_matmul.fallback"]
+        assert len(events) >= 2
+        assert events[0][5]["reason"].startswith("K % 128")
+
+        # large M: counted with its own reason, no warning even unwarned
+        w2 = jax.random.normal(jax.random.PRNGKey(6), (128, 60))
+        q2, s2 = quantize_int8(w2, axis=0)
+        quant_mod._warned_fallback = False
+        before = len([e for e in tracer.events()
+                      if e[1] == "quant.int8_matmul.fallback"])
+        with warnings.catch_warnings(record=True) as caught2:
+            warnings.simplefilter("always")
+            int8_matmul(jnp.ones((200, 128), jnp.bfloat16), q2, s2)
+        assert not [c for c in caught2 if "int8_matmul" in str(c.message)]
+        after = [e for e in tracer.events()
+                 if e[1] == "quant.int8_matmul.fallback"]
+        assert len(after) == before + 1
+        assert "KERNEL_MAX_ROWS" in after[-1][5]["reason"]
+    finally:
+        trace.disarm()
+        quant_mod._warned_fallback = True  # leave quiet for other tests
 
 
 def test_int8_embed_attend_vocab_sharded_dequant_path(devices):
