@@ -25,6 +25,15 @@ request trace so the two disciplines are directly comparable:
   the graceful-degradation ladder, and the stuck-step watchdog
   (``--watchdog-ms``); ``--stuck-round``/``--burst`` inject live faults
   and print the SERVING -> DEGRADED -> SERVING health transitions.
+- ``--mode fleet`` — the robust loop replicated: a
+  :class:`rocket_tpu.serve.FleetRouter` least-loaded-routes a scaled
+  trace (defaults jump to 2048 requests at 2 ms mean arrival) across
+  ``--replicas`` thread-backed serving replicas.  ``--prefill-replicas``
+  disaggregates the lanes — long prompts prefill on a dedicated replica
+  and their finished KV rows hand off to a decode replica — and
+  ``--kill-round K`` kills replica r0 live so the self-healing path
+  (drain, salvage, rebuild from factory, re-route) prints as it runs.
+  See docs/reliability.md ("Serving fleet").
 - ``--trace`` (implies ``--mode robust``) — arm the structured tracer
   (:mod:`rocket_tpu.observe.trace`): every round/admit/request gets a
   span, the demo prints the p50/p95 queue-wait/TTFT/TPOT/e2e table at
@@ -331,6 +340,121 @@ def run_robust(args, model, draft, params, draft_params, arrivals, prompts):
                 accepted=0, drafted=0, tally=tally)
 
 
+def run_fleet(args, model, draft, params, draft_params, arrivals, prompts):
+    """Multi-replica serving: a :class:`rocket_tpu.serve.FleetRouter`
+    load-balances the trace across ``--replicas`` thread-backed
+    :class:`rocket_tpu.serve.Replica`\\ s; ``--prefill-replicas`` adds a
+    disaggregated prefill lane (finished KV rows hand off to a decode
+    replica); ``--kill-round K`` wedges replica r0's K-th round via
+    ``ReplicaKillInjector`` so the drain -> salvage -> rebuild self-healing
+    path runs live while the rest of the fleet keeps serving."""
+    from rocket_tpu.serve import (
+        Completed, DeadlineExceeded, Failed, FleetRouter, Overloaded,
+        PrefillReplica, Replica, Request, ServingLoop,
+    )
+    from rocket_tpu.testing.chaos import ReplicaKillInjector
+
+    R, B = args.requests, args.max_batch
+    t0 = time.perf_counter()
+
+    def now():
+        return time.perf_counter() - t0
+
+    def bat_factory():
+        return ContinuousBatcher(model, draft, params, draft_params,
+                                 total_len=PROMPT + NEW, n_draft=NDRAFT)
+
+    def loop_factory():
+        return ServingLoop(bat_factory, max_batch=B,
+                           queue_capacity=args.queue_capacity, clock=now)
+
+    built = {"r0": 0}
+
+    def loop_factory_r0():
+        # wedge only the first instance: the healed rebuild is clean
+        loop = loop_factory()
+        built["r0"] += 1
+        if args.kill_round >= 0 and built["r0"] == 1:
+            return ReplicaKillInjector(loop, kill_on=(args.kill_round,))
+        return loop
+
+    replicas = [Replica(loop_factory_r0 if i == 0 else loop_factory,
+                        f"r{i}")
+                for i in range(args.replicas)]
+    prefill = [PrefillReplica(bat_factory, f"p{i}", clock=now)
+               for i in range(args.prefill_replicas)]
+    router = FleetRouter(replicas, prefill_replicas=prefill, clock=now)
+    router.start()
+    lanes = (f"{len(replicas)} decode + {len(prefill)} prefill replicas"
+             if prefill else f"{len(replicas)} replicas (merged lane)")
+    print(f"  [fleet] serving {R} requests across {lanes}")
+
+    health = {rep.replica_id: rep.health for rep in replicas}
+    heals = 0
+    submitted = 0
+    results = []
+    while submitted < R:
+        while submitted < R and arrivals[submitted] <= now():
+            deadline = (None if args.deadline_ms <= 0
+                        else now() + args.deadline_ms / 1e3)
+            router.submit(Request(rid=submitted,
+                                  prompt=prompts[submitted].astype(np.int32),
+                                  deadline=deadline))
+            submitted += 1
+        router.pump()  # supervision beat: probe, heal, collect
+        for rep in replicas:
+            h = rep.health
+            if h is not health[rep.replica_id]:
+                print(f"  [fleet] {rep.replica_id}: "
+                      f"{health[rep.replica_id].value} -> {h.value}")
+                health[rep.replica_id] = h
+        if router.counters.heals > heals:
+            heals = router.counters.heals
+            print(f"  [fleet] healed a replica: {heals} heal(s), "
+                  f"{router.counters.requeued} request(s) salvaged and "
+                  f"re-routed")
+        results.extend(router.drain_results())
+        if submitted < R:
+            time.sleep(min(2e-3,
+                           max(0.0, float(arrivals[submitted]) - now())))
+    results.extend(router.run_until_idle(max_rounds=1_000_000))
+    total = now()
+
+    kinds = {Completed: "completed", Overloaded: "overloaded",
+             DeadlineExceeded: "deadline", Failed: "failed"}
+    tally = {v: 0 for v in kinds.values()}
+    served_by = {}
+    for r in results:
+        tally[kinds[type(r)]] += 1
+        if isinstance(r, Completed):
+            rep = (r.meta or {}).get("replica")
+            served_by[rep] = served_by.get(rep, 0) + 1
+    snap = router.snapshot()
+    print(f"  [fleet] results: {tally} "
+          f"({len(results)}/{R} typed — exactly once)")
+    print(f"  [fleet] served by: "
+          + "  ".join(f"{k}={v}" for k, v in sorted(served_by.items())))
+    print(f"  [fleet] routed {int(snap['routed'])}, heals "
+          f"{int(snap['heals'])}, requeued {int(snap['requeued'])}, shed "
+          f"saturated {int(snap['shed_saturated'])}")
+    if prefill:
+        print(f"  [fleet] prefill lane: {int(snap['handoffs'])} KV "
+              f"handoffs, {int(snap['handoff_bytes'])} bytes transferred")
+    summary = router.latency().summary()
+    for name in ("ttft_ms", "tpot_ms", "e2e_ms"):
+        p50 = summary.get(f"{name}/p50")
+        if p50 is not None:
+            print(f"  [fleet] {name:<8} p50 {p50:8.1f}  "
+                  f"p95 {summary[f'{name}/p95']:8.1f}")
+    router.close()
+
+    done = [r for r in results if isinstance(r, Completed)]
+    lat = np.asarray([r.finished_at - arrivals[r.rid] for r in done])
+    return dict(lat=lat * 1e3 if lat.size else np.zeros(1), total=total,
+                dispatches=int(snap["routed"]), unit="routes",
+                accepted=0, drafted=0, tally=tally)
+
+
 def _report(name, res, n_requests):
     lat = res["lat"]
     print(f"[{name}] served {n_requests} requests in {res['dispatches']} "
@@ -354,8 +478,18 @@ def main():
     parser.add_argument("--arrival-ms", type=float, default=30.0,
                         help="mean simulated inter-arrival gap")
     parser.add_argument("--mode",
-                        choices=("group", "continuous", "both", "robust"),
+                        choices=("group", "continuous", "both", "robust",
+                                 "fleet"),
                         default="both")
+    parser.add_argument("--replicas", type=int, default=3,
+                        help="[fleet] thread-backed decode replicas")
+    parser.add_argument("--prefill-replicas", type=int, default=0,
+                        help="[fleet] disaggregated prefill-lane replicas "
+                             "(0 = merged lane: decode replicas prefill)")
+    parser.add_argument("--kill-round", type=int, default=-1,
+                        help="[fleet] kill replica r0 on this round via "
+                             "ReplicaKillInjector; the router drains, "
+                             "salvages, and rebuilds it live (-1 = off)")
     parser.add_argument("--queue-capacity", type=int, default=16,
                         help="[robust] bounded admission queue size; a "
                              "full queue rejects with a typed Overloaded")
@@ -377,10 +511,19 @@ def main():
                              "flight-recorder dump path at exit "
                              "(implies --mode robust)")
     args = parser.parse_args()
-    if args.trace and args.mode != "robust":
+    if args.trace and args.mode not in ("robust", "fleet"):
         print("--trace instruments the robust loop; switching to "
               "--mode robust")
         args.mode = "robust"
+    if args.mode == "fleet":
+        # a fleet exists to absorb scale: default the trace up to
+        # thousands of requests arriving fast (override with the flags)
+        if args.requests == 24:
+            args.requests = 2048
+        if args.arrival_ms == 30.0:
+            args.arrival_ms = 2.0
+        print(f"[fleet] trace: {args.requests} requests, mean arrival gap "
+              f"{args.arrival_ms} ms")
 
     # ONE seeded trace shared by both modes: identical arrivals and
     # prompts make the p50s directly comparable
@@ -392,7 +535,7 @@ def main():
     model, draft, params, draft_params = _build()
 
     runners = {"group": run_group, "continuous": run_continuous,
-               "robust": run_robust}
+               "robust": run_robust, "fleet": run_fleet}
     modes = ["group", "continuous"] if args.mode == "both" else [args.mode]
     results = {}
     for m in modes:

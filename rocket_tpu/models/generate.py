@@ -22,6 +22,7 @@ Usage::
 
 from __future__ import annotations
 
+import dataclasses
 import functools
 from typing import Any, Optional
 
@@ -952,6 +953,101 @@ def _spec_admit(model, draft_model, params, draft_params, state, row,
             (rounds, drafted, accepted))
 
 
+@jax.jit
+def _spec_import_row(state, row, buf1, n1, d1, c1_t, c1_d):
+    """Scatter a handed-off batch-1 row state into row ``row`` of a live
+    batch state — the IMPORT half of the prefill/decode lane handoff.
+
+    Mirrors :func:`_spec_admit`'s scatter exactly (K/V payload leaves —
+    including int8 pages and their rank-4 scales — discriminate from the
+    scalar ``cache_index`` by ``ndim == 4``; the index stays monotone via
+    ``maximum``), minus the prefill: the handoff already carries the
+    prefilled cache rows, so importing a row is a cheap scatter dispatch
+    instead of a full prompt forward.  Stale K/V the previous occupant
+    left beyond the fresh prompt are hidden by the per-row causal mask,
+    the same no-rewind argument as :func:`_spec_admit`."""
+    (buf, n_tok, done, cache_t, cache_d, key_st,
+     (rounds, drafted, accepted)) = state
+    buf = buf.at[row].set(buf1[0])
+    n_tok = n_tok.at[row].set(n1[0])
+    done = done.at[row].set(d1[0])
+
+    def scatter(batch_cache, one_cache):
+        return jax.tree_util.tree_map(
+            lambda a, b: a.at[row].set(b[0]) if getattr(a, "ndim", 0) == 4
+            else jnp.maximum(a, b),
+            batch_cache, one_cache,
+        )
+
+    cache_t = scatter(cache_t, c1_t)
+    cache_d = scatter(cache_d, c1_d)
+    drafted = drafted.at[row].set(0)
+    accepted = accepted.at[row].set(0)
+    return (buf, n_tok, done, cache_t, cache_d, key_st,
+            (rounds, drafted, accepted))
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """One request's finished prefill, packaged for a cross-replica
+    handoff: the batch-1 buffer row (prompt + first emitted token), its
+    frontier and done flag, and both models' prefilled KV-cache rows.
+
+    The transfer is BOUNDED by construction: rolling-cache models keep
+    ``attention_window + decode_rolling_slack`` slots per row however
+    long the prompt, and with ``kv_cache_int8`` the pages travel as int8
+    payload WITH their rank-4 ``[1, slots, KV, 1]`` f32 scale leaves —
+    both are ``ndim == 4``, so export, transfer, and the import scatter
+    treat them uniformly.  :meth:`to_host` materializes every leaf as
+    numpy, the wire format a process-backed replica would ship.
+    """
+
+    buf: Any
+    n_tok: Any
+    done: Any
+    cache_t: Any
+    cache_d: Any
+
+    def _tree(self):
+        return (self.buf, self.n_tok, self.done, self.cache_t,
+                self.cache_d)
+
+    def to_host(self) -> "KVHandoff":
+        """Copy every leaf to host numpy (blocks on the prefill)."""
+        return KVHandoff(*jax.tree_util.tree_map(np.asarray, self._tree()))
+
+    @property
+    def total_len(self) -> int:
+        return int(self.buf.shape[1])
+
+    @property
+    def nbytes(self) -> int:
+        """Transfer size of the packaged row — ``fleet/handoff_bytes``
+        telemetry; int8 caches are ~4x smaller than f32 here."""
+        return int(sum(leaf.nbytes
+                       for leaf in jax.tree_util.tree_leaves(self._tree())))
+
+
+def export_kv_row(state, row: int) -> KVHandoff:
+    """Slice one row of a batched round state into a :class:`KVHandoff`.
+
+    Rank-4 cache leaves (K/V payload and int8 scales alike) slice to
+    batch 1; scalar leaves (``cache_index``) copy whole — the exact
+    inverse discrimination :func:`_spec_import_row` applies on import.
+    Used by :meth:`ContinuousBatcher.prefill_handoff` (row 0 of a fresh
+    batch-1 prefill) and available for migrating a live row between
+    replicas."""
+    (buf, n_tok, done, cache_t, cache_d, _key, _stats) = state
+    sl = lambda a: a[row:row + 1] if getattr(a, "ndim", 0) == 4 else a  # noqa: E731
+    return KVHandoff(
+        buf=buf[row:row + 1],
+        n_tok=n_tok[row:row + 1],
+        done=done[row:row + 1],
+        cache_t=jax.tree_util.tree_map(sl, cache_t),
+        cache_d=jax.tree_util.tree_map(sl, cache_d),
+    )
+
+
 class ContinuousBatcher:
     """Round-granular continuous batching over the batched speculative
     decoder — the serving-loop counterpart of the one-dispatch
@@ -1151,6 +1247,78 @@ class ContinuousBatcher:
             self._model, self._draft_model, self._params,
             self._draft_params, self.state, jnp.int32(row), prompt_row,
             key, self._temperature, **self._kw(),
+        )
+
+    def prefill_handoff(self, prompt_row, *, key=None) -> "KVHandoff":
+        """Run ONE request's prefill at batch 1 and package the result as
+        a :class:`KVHandoff` — the EXPORT half of the prefill/decode lane
+        split.  Works on an un-started batcher (a dedicated prefill
+        replica never calls :meth:`start`); the live decode batch is
+        untouched.
+
+        Key discipline: the admit counter advances and derives the row
+        key exactly like :meth:`admit`, so a prefill-lane batcher owns
+        its own key stream.  Greedy decoding (``sampled=False``) never
+        consumes the key, so a handed-off row is bit-identical to a
+        local :meth:`admit` of the same prompt on the decode replica —
+        the fleet bit-equality contract.  Sampled handoffs need the
+        caller to coordinate keys across lanes via ``key=``.
+        """
+        prompt_row = jnp.asarray(prompt_row, jnp.int32)
+        if prompt_row.ndim == 1:
+            prompt_row = prompt_row[None, :]
+        if prompt_row.ndim != 2 or prompt_row.shape[0] != 1 \
+                or prompt_row.shape[1] < 1:
+            raise ValueError(
+                f"prefill_handoff() needs a single non-empty prompt row "
+                f"([P] or [1, P]), got shape "
+                f"{tuple(jnp.asarray(prompt_row).shape)}"
+            )
+        P = prompt_row.shape[1]
+        if P + 1 > self.total_len:
+            raise ValueError(
+                f"prompt length {P} + 1 exceeds total_len "
+                f"({self.total_len})"
+            )
+        if key is None:
+            self._admits += 1
+            key = jax.random.fold_in(self._rng, self._admits)
+        state1 = _spec_prefill(
+            self._model, self._draft_model, self._params,
+            self._draft_params, prompt_row, key, self._temperature,
+            max_new_tokens=self.total_len - P, **self._kw(),
+        )
+        return export_kv_row(state1, 0)
+
+    def admit_prefilled(self, row: int, handoff: "KVHandoff", *,
+                        preempt: bool = False) -> None:
+        """Import a :class:`KVHandoff` into row ``row`` — the decode-lane
+        counterpart of :meth:`admit` minus the prefill: a cheap scatter
+        dispatch, so long prompts prefilled elsewhere never stall the
+        decode rounds here.  Same occupancy rules as :meth:`admit`."""
+        if self.state is None:
+            raise ValueError("call start() before admit_prefilled()")
+        B = self.state[0].shape[0]
+        if not 0 <= row < B:
+            raise ValueError(
+                f"admit_prefilled() row {row} out of range for batch of "
+                f"{B} rows"
+            )
+        if not preempt and not bool(np.asarray(self.state[2])[row]):
+            raise ValueError(
+                f"admit_prefilled() into row {row} which is still "
+                f"decoding — harvest it first (done flag unset), or pass "
+                f"preempt=True to drop its occupant deliberately"
+            )
+        if int(handoff.total_len) != self.total_len:
+            raise ValueError(
+                f"handoff total_len ({handoff.total_len}) != this "
+                f"batcher's total_len ({self.total_len}); prefill and "
+                f"decode lanes must share the buffer layout"
+            )
+        self.state = _spec_import_row(
+            self.state, jnp.int32(row), handoff.buf, handoff.n_tok,
+            handoff.done, handoff.cache_t, handoff.cache_d,
         )
 
     def retire(self, row: int) -> None:

@@ -342,6 +342,13 @@ class Histogram:
             f"{prefix}/count": float(self.count),
         }
 
+    def merge(self, other: "Histogram") -> None:
+        """Fold another histogram's window (and lifetime count) into
+        this one — fleet-wide percentile aggregation across replicas.
+        Bounded by this histogram's own capacity like every record."""
+        self._samples.extend(other._samples)
+        self.count += other.count
+
 
 # -- multi-host merge --------------------------------------------------------
 

@@ -5,20 +5,27 @@ fault-free bit-equality contract; ``docs/reliability.md`` ("Serving
 reliability") for the operator view.
 """
 
+from rocket_tpu.serve.fleet import PrefillReplica, Replica
 from rocket_tpu.serve.loop import ServingLoop
-from rocket_tpu.serve.metrics import ServeCounters, ServeLatency
+from rocket_tpu.serve.metrics import (
+    FleetCounters,
+    ServeCounters,
+    ServeLatency,
+)
 from rocket_tpu.serve.policy import (
     DEFAULT_LADDER,
     DegradationLevel,
     DegradationPolicy,
 )
 from rocket_tpu.serve.queue import AdmissionQueue
+from rocket_tpu.serve.router import FleetRouter
 from rocket_tpu.serve.types import (
     Completed,
     DeadlineExceeded,
     Failed,
     HealthState,
     Overloaded,
+    ReplicaId,
     Request,
     Result,
 )
@@ -33,8 +40,13 @@ __all__ = [
     "DegradationPolicy",
     "DispatchWatchdog",
     "Failed",
+    "FleetCounters",
+    "FleetRouter",
     "HealthState",
     "Overloaded",
+    "PrefillReplica",
+    "Replica",
+    "ReplicaId",
     "Request",
     "Result",
     "ServeCounters",

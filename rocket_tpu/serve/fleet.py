@@ -1,0 +1,437 @@
+"""Fleet replicas — the units a :class:`~rocket_tpu.serve.router.FleetRouter`
+load-balances across.
+
+Two kinds, one per lane:
+
+- :class:`Replica` wraps one :class:`~rocket_tpu.serve.ServingLoop`
+  (the DECODE lane, or a merged lane when no prefill replicas exist).
+  Thread-backed first: :meth:`start` spawns a driver thread pumping
+  ``run_round``; a process-backed replica would implement the same
+  surface (``submit`` / ``pump`` / ``drain_results`` / ``probe`` /
+  ``heal`` / ``health`` / ``load``) over an IPC channel — which is why
+  the router-side request shadow (``_outstanding``) is the salvage
+  source of truth, never the possibly-dead loop's internals.
+- :class:`PrefillReplica` wraps a bare, un-started
+  :class:`~rocket_tpu.models.generate.ContinuousBatcher` and runs ONLY
+  prefills (:meth:`~ContinuousBatcher.prefill_handoff`), delivering each
+  finished :class:`~rocket_tpu.models.generate.KVHandoff` to the router,
+  which re-routes the request — now carrying its prefilled KV rows — to
+  a decode replica.  Long prompts burn this lane's time, not the decode
+  rounds' (the disaggregation the Gemma-on-TPU serving comparison
+  motivates).
+
+Self-healing contract (both kinds): a watchdog trip, probe failure, or
+pump exception marks the replica dead (``health`` reports ``DRAINING``
+so routing skips it); :meth:`heal` rebuilds from the factory and returns
+``(final_results, salvaged_requests)`` — salvaged requests never had a
+typed result emitted, so re-routing them preserves the exactly-one-
+result-per-request contract.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from collections import deque
+
+from rocket_tpu.serve.types import HealthState, ReplicaId, Request
+
+LOG = logging.getLogger("rocket_tpu.serve.fleet")
+
+
+class Replica:
+    """One decode-lane serving replica: a factory-built ``ServingLoop``
+    plus the router-facing shell — identity, health probing, a request
+    shadow for salvage, and replica-level rebuild.
+
+    ``loop_factory`` must return a fresh ``ServingLoop`` each call (the
+    heal path abandons the sick instance).  ``max_watchdog_trips`` turns
+    repeated loop-level recoveries into a replica-level heal: the loop
+    rebuilds its own batcher per trip, but a replica tripping over and
+    over is sick beyond that — the router drains and rebuilds it whole.
+    """
+
+    def __init__(self, loop_factory: Callable[[], Any],
+                 replica_id: ReplicaId, *,
+                 max_watchdog_trips: Optional[int] = None,
+                 tracer: Optional[Any] = None,
+                 logger: Optional[logging.Logger] = None) -> None:
+        self.replica_id = replica_id
+        self._factory = loop_factory
+        self._max_trips = max_watchdog_trips
+        self._tracer = tracer
+        self._log = logger if logger is not None else LOG
+        self._dead: Optional[str] = None
+        self._lock = threading.RLock()
+        # rid -> Request for every request this replica accepted and has
+        # not yet answered — the salvage source of truth (readable even
+        # when the loop itself is wedged or gone).
+        self._outstanding: Dict[Any, Request] = {}
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self.loop = self._build()
+
+    def _build(self) -> Any:
+        loop = self._factory()
+        if getattr(loop, "replica_id", None) is None:
+            loop.replica_id = self.replica_id
+            loop.queue.name = self.replica_id
+        return loop
+
+    # -- health --------------------------------------------------------
+
+    @property
+    def health(self) -> HealthState:
+        """The loop's own state machine, with replica death mapped onto
+        the existing vocabulary: a dead (or unreadable) replica reports
+        ``DRAINING`` — no new admissions — until healed."""
+        if self._dead is not None:
+            return HealthState.DRAINING
+        try:
+            return self.loop.health
+        except Exception:
+            return HealthState.DRAINING
+
+    def probe(self) -> bool:
+        """Active liveness check the router's supervision loop calls.
+        ``False`` demands a heal: already dead, a died driver thread, a
+        chaos-injected probe failure (any ``probe_healthy`` attribute on
+        the loop — duck-typed so proxies can inject flakiness), or too
+        many watchdog trips."""
+        if self._dead is not None:
+            return False
+        if self._thread is not None and not self._thread.is_alive() \
+                and self._stop is not None and not self._stop.is_set():
+            self._dead = "driver thread died"
+            return False
+        probe_fn = getattr(self.loop, "probe_healthy", None)
+        if probe_fn is not None and not probe_fn():
+            self._dead = "health probe failed"
+            return False
+        if self._max_trips is not None \
+                and self.loop.counters.watchdog_trips >= self._max_trips:
+            self._dead = (
+                f"{self.loop.counters.watchdog_trips} watchdog trips"
+            )
+            return False
+        return True
+
+    @property
+    def load(self) -> int:
+        """Least-loaded routing signal; a dead replica reports saturated
+        so it sorts last even before supervision notices."""
+        if self._dead is not None:
+            return 1 << 30
+        try:
+            return self.loop.load
+        except Exception:
+            return 1 << 30
+
+    # -- request flow --------------------------------------------------
+
+    def submit(self, req: Request) -> bool:
+        """Offer a request; ``True`` = accepted (this replica now owes
+        its typed result).  Refusals are side-effect-free — the router
+        tries the next replica or sheds at fleet level."""
+        if self._dead is not None:
+            return False
+        with self._lock:
+            try:
+                if getattr(req, "_handoff", None) is not None:
+                    rej = self.loop.submit_prefilled(
+                        req, req._handoff, record_rejection=False)
+                else:
+                    rej = self.loop.submit(req, record_rejection=False)
+            except Exception as exc:
+                self._dead = f"submit failed: {exc!r}"
+                return False
+            if rej is not None:
+                return False
+            self._outstanding[req.rid] = req
+            return True
+
+    def pump(self) -> bool:
+        """One ``run_round`` (sync mode — the router drives it when no
+        driver thread runs).  An escaped exception is replica death: the
+        loop's own recovery already absorbs step errors, so anything
+        thrown past it means the loop object itself is broken."""
+        if self._dead is not None:
+            return False
+        try:
+            return bool(self.loop.run_round())
+        except Exception as exc:
+            self._log.warning("fleet: replica %s died: %r",
+                              self.replica_id, exc)
+            self._dead = f"pump failed: {exc!r}"
+            return False
+
+    def drain_results(self) -> List[Any]:
+        """Collect the loop's typed results, settling the shadow: an
+        answered request is no longer salvageable."""
+        if self._dead is not None:
+            return []
+        with self._lock:
+            try:
+                results = self.loop.drain_results()
+            except Exception as exc:
+                self._dead = f"drain failed: {exc!r}"
+                return []
+            for res in results:
+                self._outstanding.pop(res.rid, None)
+        return results
+
+    # -- self-healing --------------------------------------------------
+
+    def heal(self) -> Tuple[List[Any], List[Request]]:
+        """Drain-and-rebuild: stop the driver, collect any final typed
+        results the old loop managed to produce, salvage everything
+        still unanswered, and rebuild the loop from the factory.
+        Returns ``(final_results, salvaged_requests)`` — every request
+        this replica ever accepted appears in exactly one of the two."""
+        was_threaded = self._thread is not None
+        self._stop_thread()
+        old = self.loop
+        final: List[Any] = []
+        try:
+            final = old.drain_results()
+        except Exception:
+            pass
+        try:
+            old.salvage()   # strips the old loop; shadow already has them
+            old.close()
+        except Exception:
+            pass
+        # Timed acquire: a driver wedged in device code while holding the
+        # lock was abandoned, not joined — block bounded, then proceed
+        # (reads of the shadow dict are safe under the GIL).
+        got = self._lock.acquire(timeout=2.0)
+        try:
+            for res in final:
+                self._outstanding.pop(res.rid, None)
+            salvaged = list(self._outstanding.values())
+            self._outstanding.clear()
+        finally:
+            if got:
+                self._lock.release()
+        for req in salvaged:
+            # the handoff came from a possibly-poisoned lane; re-prefill
+            if getattr(req, "_handoff", None) is not None:
+                req._handoff = None
+        # rebuild BEFORE clearing the death flag: ``submit`` gates on
+        # ``_dead`` and then reads ``self.loop`` — clearing first would
+        # open a window where a concurrent submit lands in the old,
+        # already-salvaged loop and the request is stranded
+        self.loop = self._build()
+        self._dead = None
+        if was_threaded:
+            self.start()
+        return final, salvaged
+
+    # -- threading -----------------------------------------------------
+
+    @property
+    def threaded(self) -> bool:
+        return self._thread is not None
+
+    def start(self, idle_s: float = 0.001) -> None:
+        """Spawn the driver thread: pump rounds, idle-wait when there is
+        nothing to do.  The closure captures ITS OWN stop event and loop
+        snapshot-by-attribute, so a wedged zombie thread abandoned by
+        :meth:`heal` can never drive the rebuilt loop."""
+        if self._thread is not None:
+            return
+        stop = threading.Event()
+
+        def drive() -> None:
+            while not stop.is_set():
+                if self._dead is not None:
+                    stop.wait(idle_s)
+                    continue
+                with self._lock:
+                    busy = self.pump()
+                if not busy:
+                    stop.wait(idle_s)
+
+        self._stop = stop
+        self._thread = threading.Thread(
+            target=drive, name=f"replica-{self.replica_id}", daemon=True)
+        self._thread.start()
+
+    def _stop_thread(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        # a thread that did not join is wedged in device code — abandon
+        # it (its stop event is set; the watchdog-style non-join rule)
+        self._thread = None
+        self._stop = None
+
+    def stop(self) -> None:
+        self._stop_thread()
+
+    def close(self) -> None:
+        self._stop_thread()
+        try:
+            self.loop.close()
+        except Exception:
+            pass
+
+
+class PrefillReplica:
+    """One prefill-lane replica: accepts requests, runs ONLY their
+    prefill on its own batcher, and delivers the resulting
+    :class:`~rocket_tpu.models.generate.KVHandoff` to the router's
+    ``deliver(kind, req, payload)`` callback (``kind`` in ``{"handoff",
+    "shed"}``).  The batcher is never :meth:`start`-ed — the prefill
+    lane owns no decode rows."""
+
+    def __init__(self, batcher_factory: Callable[[], Any],
+                 replica_id: ReplicaId, *, capacity: int = 64,
+                 clock: Callable[[], float] = time.monotonic,
+                 tracer: Optional[Any] = None,
+                 logger: Optional[logging.Logger] = None) -> None:
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.replica_id = replica_id
+        self._factory = batcher_factory
+        self.capacity = int(capacity)
+        self._clock = clock
+        self._tracer = tracer
+        self._log = logger if logger is not None else LOG
+        self._deliver: Optional[Callable[[str, Request, Any], None]] = None
+        self._pending: deque = deque()
+        self._inflight = 0
+        self._dead: Optional[str] = None
+        self._lock = threading.RLock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self._bat = self._factory()
+
+    @property
+    def health(self) -> HealthState:
+        return HealthState.DRAINING if self._dead is not None \
+            else HealthState.SERVING
+
+    def probe(self) -> bool:
+        if self._dead is not None:
+            return False
+        if self._thread is not None and not self._thread.is_alive() \
+                and self._stop is not None and not self._stop.is_set():
+            self._dead = "driver thread died"
+            return False
+        probe_fn = getattr(self._bat, "probe_healthy", None)
+        if probe_fn is not None and not probe_fn():
+            self._dead = "health probe failed"
+            return False
+        return True
+
+    @property
+    def load(self) -> int:
+        if self._dead is not None:
+            return 1 << 30
+        return len(self._pending) + self._inflight
+
+    def submit(self, req: Request) -> bool:
+        if self._dead is not None:
+            return False
+        with self._lock:
+            if len(self._pending) >= self.capacity:
+                return False
+            self._pending.append(req)
+            return True
+
+    def pump(self) -> bool:
+        """Prefill ONE pending request and deliver its handoff.  The
+        in-flight count rises before the pop and falls only after the
+        delivery, so ``load`` (hence the router's ``busy``) never
+        transiently reads idle mid-prefill."""
+        if self._dead is not None or self._deliver is None:
+            return False
+        with self._lock:
+            if not self._pending:
+                return False
+            self._inflight += 1
+            req = self._pending.popleft()
+        try:
+            now = self._clock()
+            if req.deadline is not None and req.deadline <= now:
+                self._deliver("shed", req, None)
+                return True
+            try:
+                span = self._tracer.span(
+                    "fleet/prefill", rid=req.rid,
+                    replica=self.replica_id,
+                    prompt_len=int(req.prompt.shape[0]),
+                ) if self._tracer is not None else None
+                if span is not None:
+                    with span:
+                        handoff = self._bat.prefill_handoff(
+                            req.prompt[None, :])
+                else:
+                    handoff = self._bat.prefill_handoff(req.prompt[None, :])
+            except Exception as exc:
+                self._log.warning("fleet: prefill replica %s died: %r",
+                                  self.replica_id, exc)
+                with self._lock:
+                    self._pending.appendleft(req)  # salvageable
+                self._dead = f"prefill failed: {exc!r}"
+                return False
+            self._deliver("handoff", req, handoff)
+            return True
+        finally:
+            with self._lock:
+                self._inflight -= 1
+
+    def heal(self) -> Tuple[List[Any], List[Request]]:
+        """Rebuild the batcher; pending (never-prefilled) requests are
+        salvaged for the router to re-route.  Prefill replicas hold no
+        results — the first tuple slot exists for interface symmetry."""
+        was_threaded = self._thread is not None
+        self._stop_thread()
+        with self._lock:
+            salvaged = list(self._pending)
+            self._pending.clear()
+        # same ordering rule as Replica.heal: new batcher in place
+        # before submits stop refusing
+        self._bat = self._factory()
+        self._dead = None
+        if was_threaded:
+            self.start()
+        return [], salvaged
+
+    @property
+    def threaded(self) -> bool:
+        return self._thread is not None
+
+    def start(self, idle_s: float = 0.001) -> None:
+        if self._thread is not None:
+            return
+        stop = threading.Event()
+
+        def drive() -> None:
+            while not stop.is_set():
+                if self._dead is not None or not self.pump():
+                    stop.wait(idle_s)
+
+        self._stop = stop
+        self._thread = threading.Thread(
+            target=drive, name=f"prefill-{self.replica_id}", daemon=True)
+        self._thread.start()
+
+    def _stop_thread(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self._thread = None
+        self._stop = None
+
+    def stop(self) -> None:
+        self._stop_thread()
+
+    def close(self) -> None:
+        self._stop_thread()

@@ -131,6 +131,7 @@ class ServingLoop:
         recorder: Optional[Any] = None,
         logger: Optional[logging.Logger] = None,
         kv_cache_int8: Optional[bool] = None,
+        replica_id: Optional[str] = None,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -141,10 +142,6 @@ class ServingLoop:
         # a recovery cannot silently drop the quantized layout.
         self._kv_cache_int8 = kv_cache_int8
         self._max_batch = int(max_batch)
-        self.queue = AdmissionQueue(queue_capacity)
-        self.policy = policy if policy is not None else DegradationPolicy()
-        self.watchdog = DispatchWatchdog(watchdog_timeout)
-        self.counters = ServeCounters()
         self._beam_fn = beam_fn
         self._clock = clock
         self._sink = sink
@@ -156,6 +153,16 @@ class ServingLoop:
         # ``recorder`` overrides the process-global flight recorder for
         # crash dumps on trips/step errors.
         self._tracer = tracer if tracer is not None else get_tracer()
+        # Fleet identity: rides every typed result's ``meta`` and names
+        # this loop's queue counters (``serve/queue/<replica>/...``).
+        self.replica_id = replica_id
+        self.queue = AdmissionQueue(
+            queue_capacity, name=replica_id, tracer=self._tracer,
+            clock=clock,
+        )
+        self.policy = policy if policy is not None else DegradationPolicy()
+        self.watchdog = DispatchWatchdog(watchdog_timeout)
+        self.counters = ServeCounters()
         self._recorder = recorder
         self.latency = ServeLatency()
         self._last_health = HealthState.SERVING
@@ -229,28 +236,83 @@ class ServingLoop:
 
     # -- submission ----------------------------------------------------
 
-    def submit(self, req: Request) -> Optional[Overloaded]:
+    def _meta(self) -> Dict[str, Any]:
+        """WHERE a result was decided: replica identity + degradation
+        level at the moment of the decision — stamped on every typed
+        result so fleet tests can assert routing without internals."""
+        return {"replica": self.replica_id, "level": self.policy.level}
+
+    @property
+    def load(self) -> int:
+        """Queued + in-flight request count — the least-loaded routing
+        signal a :class:`~rocket_tpu.serve.router.FleetRouter` reads."""
+        return len(self.queue) + len(self._live_rows())
+
+    def submit(self, req: Request, *,
+               record_rejection: bool = True) -> Optional[Overloaded]:
         """Enqueue a request.  Returns ``None`` on acceptance, or the
         typed :class:`Overloaded` rejection (also appended to
         :meth:`drain_results`) when the queue is full or the loop is
-        draining — admission control answers IMMEDIATELY."""
-        self.counters.submitted += 1
+        draining — admission control answers IMMEDIATELY.
+
+        ``record_rejection=False`` makes a refusal side-effect-free (no
+        counters, no result recorded): a fleet router probing replicas
+        owns the request's single typed result, and a refusal here just
+        means "try the next replica"."""
         # Queue-wait / TTFT / e2e all measure from this stamp (the loop
         # clock, so fake-clock tests stay deterministic).  Request is a
         # plain dataclass — the private stamp rides the object.
         req._submit_ts = self._clock()
         self._tracer.instant("serve/submit", rid=req.rid)
         if self._draining:
-            rej = Overloaded(req.rid, self._clock(), reason="draining")
+            rej = Overloaded(req.rid, self._clock(), reason="draining",
+                             meta=self._meta())
         elif not self.queue.offer(req):
-            rej = Overloaded(req.rid, self._clock(), reason="queue full")
+            rej = Overloaded(req.rid, self._clock(), reason="queue full",
+                             meta=self._meta())
         else:
+            self.counters.submitted += 1
             return None
-        self.counters.shed_overload += 1
-        self._tracer.instant("serve/overloaded", rid=req.rid,
-                             reason=rej.reason)
-        self._results.append(rej)
+        if record_rejection:
+            self.counters.submitted += 1
+            self.counters.shed_overload += 1
+            self._tracer.instant("serve/overloaded", rid=req.rid,
+                                 reason=rej.reason)
+            self._results.append(rej)
         return rej
+
+    def submit_prefilled(self, req: Request, handoff: Any, *,
+                         record_rejection: bool = True
+                         ) -> Optional[Overloaded]:
+        """Submit a request whose prefill already ran on another lane
+        (a :class:`~rocket_tpu.models.generate.KVHandoff`): admission
+        imports the handed-off KV rows instead of prefilling, so long
+        prompts never stall this loop's decode rounds."""
+        req._handoff = handoff
+        return self.submit(req, record_rejection=record_rejection)
+
+    def salvage(self) -> List[Request]:
+        """Strip every queued and in-flight request out of the loop
+        WITHOUT emitting results for them — the fleet self-healing hook:
+        the router re-enqueues the salvaged requests (remaining deadline
+        intact) on a healthy replica, which then owns each one's single
+        typed result.  In-flight rows retire so their slots go idle."""
+        salvaged: List[Request] = []
+        while True:
+            req = self.queue.pop()
+            if req is None:
+                break
+            salvaged.append(req)
+        for row, occ in self._rows.items():
+            if occ is None:
+                continue
+            salvaged.append(occ.req)
+            try:
+                self._bat.retire(row)
+            except Exception:  # a wedged batcher cannot even retire
+                pass
+            self._rows[row] = None
+        return salvaged
 
     def drain_results(self) -> List[Any]:
         """Return and clear all typed results produced so far."""
@@ -308,7 +370,8 @@ class ServingLoop:
         for req in self.queue.shed_hopeless(now, floor_s):
             self.counters.shed_deadline += 1
             self._results.append(
-                DeadlineExceeded(req.rid, now, stage="queue")
+                DeadlineExceeded(req.rid, now, stage="queue",
+                                 meta=self._meta())
             )
 
     def _admit_pending(self, now: float) -> None:
@@ -326,7 +389,8 @@ class ServingLoop:
                 if req.deadline is not None and req.deadline <= now:
                     self.counters.shed_deadline += 1
                     self._results.append(
-                        DeadlineExceeded(req.rid, now, stage="queue")
+                        DeadlineExceeded(req.rid, now, stage="queue",
+                                         meta=self._meta())
                     )
                 elif req.beam and level.beam and self._beam_fn is not None:
                     self._serve_beam(req, now)
@@ -351,13 +415,22 @@ class ServingLoop:
         submitted = getattr(req, "_submit_ts", None)
         wait_ms = (now - submitted) * 1e3 if submitted is not None else 0.0
         self.latency.queue_wait_ms.record(wait_ms)
+        handoff = getattr(req, "_handoff", None)
         # The admit IS the row's prefill (the batcher rebuilds the row's
         # cache from the prompt) — one span covers admission + prefill.
+        # A handed-off request skips the prefill: its KV rows import as
+        # one cheap scatter dispatch (the prefill/decode lane split).
         with self._tracer.span(
             "serve/admit", rid=req.rid, row=row,
             prompt_len=int(prompt.shape[0]), queue_wait_ms=wait_ms,
+            prefilled=handoff is not None,
         ):
-            self._bat.admit(row, prompt[None, :])
+            if handoff is not None:
+                self._bat.admit_prefilled(row, handoff)
+                req._handoff = None
+                self.counters.prefilled_admits += 1
+            else:
+                self._bat.admit(row, prompt[None, :])
         self._rows[row] = _Row(req, now, prompt.shape[0], budget,
                                requested, demoted, submitted_at=submitted)
         self.counters.admitted += 1
@@ -380,7 +453,7 @@ class ServingLoop:
         self.latency.e2e_ms.record((done - submitted) * 1e3)
         self._results.append(Completed(
             req.rid, done, tokens=toks, n_tok=int(toks.shape[0]),
-            via_beam=True,
+            via_beam=True, meta=self._meta(),
         ))
 
     def _dispatch(self) -> bool:
@@ -487,7 +560,7 @@ class ServingLoop:
                                  row=row, reason=reason)
             self._results.append(Failed(
                 occ.req.rid, now, tokens=toks, n_tok=n, reason=reason,
-                dump_path=dump_path,
+                dump_path=dump_path, meta=self._meta(),
             ))
             self._rows[row] = None
 
@@ -519,7 +592,7 @@ class ServingLoop:
                 self._finish_latency(occ, now, nt, "serve/complete", row)
                 self._results.append(Completed(
                     occ.req.rid, now, tokens=toks, n_tok=nt,
-                    beam_demoted=occ.demoted,
+                    beam_demoted=occ.demoted, meta=self._meta(),
                 ))
                 self._rows[row] = None
             elif occ.req.deadline is not None and occ.req.deadline <= now:
@@ -529,7 +602,7 @@ class ServingLoop:
                 self._finish_latency(occ, now, n, "serve/evict", row)
                 self._results.append(DeadlineExceeded(
                     occ.req.rid, now, tokens=toks[:n], n_tok=n,
-                    stage="decode",
+                    stage="decode", meta=self._meta(),
                 ))
                 self._rows[row] = None
             elif produced >= occ.budget:
@@ -543,6 +616,7 @@ class ServingLoop:
                 self._results.append(Completed(
                     occ.req.rid, now, tokens=toks, n_tok=nt,
                     truncated=truncated, beam_demoted=occ.demoted,
+                    meta=self._meta(),
                 ))
                 self._rows[row] = None
 

@@ -22,6 +22,7 @@ class ServeCounters:
     def __init__(self) -> None:
         self.submitted = 0
         self.admitted = 0
+        self.prefilled_admits = 0   # admissions that imported a KVHandoff
         self.completed = 0
         self.shed_overload = 0      # bounded-queue / draining rejections
         self.shed_deadline = 0      # shed before prefill (stage='queue')
@@ -52,6 +53,7 @@ class ServeCounters:
         return {
             "submitted": float(self.submitted),
             "admitted": float(self.admitted),
+            "prefilled_admits": float(self.prefilled_admits),
             "completed": float(self.completed),
             "shed_overload": float(self.shed_overload),
             "shed_deadline": float(self.shed_deadline),
@@ -94,3 +96,38 @@ class ServeLatency:
         for name in ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
             out.update(getattr(self, name).summary(name))
         return out
+
+    def merge(self, other: "ServeLatency") -> None:
+        """Fold another replica's histograms into this one — the fleet
+        router aggregates per-replica latencies into one fleet-wide
+        percentile view without touching the replicas' own state."""
+        for name in ("queue_wait_ms", "ttft_ms", "tpot_ms", "e2e_ms"):
+            getattr(self, name).merge(getattr(other, name))
+
+
+class FleetCounters:
+    """Router-level counters — the fleet analogue of
+    :class:`ServeCounters`; per-replica counters stay on each replica's
+    own loop, these count only decisions the ROUTER made."""
+
+    def __init__(self) -> None:
+        self.submitted = 0          # requests handed to the router
+        self.routed = 0             # accepted by some replica
+        self.handoffs = 0           # prefill lane -> decode lane transfers
+        self.handoff_bytes = 0      # total KVHandoff payload moved
+        self.requeued = 0           # salvaged from a sick replica, re-routed
+        self.heals = 0              # replica rebuilds the router ordered
+        self.shed_saturated = 0     # every replica refused (fleet-level shed)
+        self.deadline_shed_prefill = 0  # deadline passed in the prefill lane
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "submitted": float(self.submitted),
+            "routed": float(self.routed),
+            "handoffs": float(self.handoffs),
+            "handoff_bytes": float(self.handoff_bytes),
+            "requeued": float(self.requeued),
+            "heals": float(self.heals),
+            "shed_saturated": float(self.shed_saturated),
+            "deadline_shed_prefill": float(self.deadline_shed_prefill),
+        }

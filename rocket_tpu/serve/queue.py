@@ -12,6 +12,7 @@ it spends a prefill dispatch.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import List, Optional
 
@@ -25,16 +26,38 @@ class AdmissionQueue:
     sheds hopeless entries when asked; the :class:`ServingLoop` owns the
     typed results and the counters, so every shed is accounted for
     exactly once.
+
+    With a ``tracer`` attached the queue emits its depth and the age of
+    its oldest entry as ``serve/queue/<name>/depth`` /
+    ``serve/queue/<name>/oldest_age_s`` counters on every change, so
+    per-replica queue pressure shows up in flight-recorder dumps
+    alongside the loop-level round stats.
     """
 
-    def __init__(self, capacity: int) -> None:
+    def __init__(self, capacity: int, *, name: Optional[str] = None,
+                 tracer=None, clock=time.monotonic) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
+        self.name = name or "loop"
+        self._tracer = tracer
+        self._clock = clock
         self._items: deque = deque()
 
     def __len__(self) -> int:
         return len(self._items)
+
+    def _observe(self) -> None:
+        if self._tracer is None:
+            return
+        prefix = f"serve/queue/{self.name}"
+        self._tracer.counter(f"{prefix}/depth", len(self._items))
+        age = 0.0
+        if self._items:
+            enq = getattr(self._items[0], "_enq_ts", None)
+            if enq is not None:
+                age = max(0.0, self._clock() - enq)
+        self._tracer.counter(f"{prefix}/oldest_age_s", age)
 
     @property
     def depth_frac(self) -> float:
@@ -47,11 +70,17 @@ class AdmissionQueue:
         :class:`~rocket_tpu.serve.types.Overloaded`)."""
         if len(self._items) >= self.capacity:
             return False
+        request._enq_ts = self._clock()
         self._items.append(request)
+        self._observe()
         return True
 
     def pop(self) -> Optional[Request]:
-        return self._items.popleft() if self._items else None
+        if not self._items:
+            return None
+        req = self._items.popleft()
+        self._observe()
+        return req
 
     def shed_hopeless(self, now: float, floor_s: float) -> List[Request]:
         """Remove and return every queued request whose deadline cannot
@@ -68,4 +97,6 @@ class AdmissionQueue:
             else:
                 kept.append(req)
         self._items = kept
+        if shed:
+            self._observe()
         return shed

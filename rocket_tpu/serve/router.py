@@ -1,0 +1,319 @@
+"""FleetRouter — least-loaded routing, lane handoff, and self-healing
+supervision over a set of serving replicas.
+
+The single-replica robustness story (`loop.py`) hardens one batcher;
+this layer hardens the FLEET: requests route to the least-loaded healthy
+replica, a sick replica is drained and rebuilt while the others keep
+serving, and only when EVERY replica refuses does a request shed at
+fleet level (typed ``Overloaded(reason="fleet saturated")``).
+
+Lanes.  With ``prefill_replicas`` the router disaggregates: a request
+whose prompt meets ``prefill_threshold`` first visits a prefill replica,
+which runs the chunked prefill and hands the finished rolling-cache KV
+rows back (a bounded :class:`~rocket_tpu.models.generate.KVHandoff` —
+int8 pages travel with their rank-4 scales); the router then routes the
+request — now prefill-free — to a decode replica, whose admission is one
+cheap scatter dispatch.  Long prompts burn prefill-lane time; decode
+TPOT stays flat (the acceptance test drives exactly this).
+
+Exactly-once results.  Every request submitted to the router resolves to
+EXACTLY ONE typed result, wherever it traveled: replica submits run
+side-effect-free (``record_rejection=False``), salvaged requests from a
+healed replica re-route without double-counting, and the fleet-level
+shed is the router's own typed result.  ``Result.meta["replica"]``
+records who decided each fate.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from rocket_tpu.observe.recorder import active_recorder
+from rocket_tpu.serve.fleet import PrefillReplica, Replica
+from rocket_tpu.serve.metrics import FleetCounters, ServeLatency
+from rocket_tpu.serve.types import (
+    DeadlineExceeded,
+    HealthState,
+    Overloaded,
+    Request,
+)
+
+LOG = logging.getLogger("rocket_tpu.serve.fleet")
+
+
+class FleetRouter:
+    """Route typed :class:`Request`s across ``replicas`` (decode lane)
+    and optionally ``prefill_replicas`` (prefill lane).
+
+    ``prefill_threshold`` — minimum prompt length that takes the prefill
+    lane (``None`` = every request, when the lane exists).  Short
+    prompts skip the extra hop: their prefill is cheap enough to run on
+    the decode replica.
+
+    Supervision: :meth:`pump` (or the caller's own cadence via
+    :meth:`supervise`) probes every replica, heals the dead ones —
+    flight-recorder dump, drain, salvage, rebuild-from-factory — and
+    re-routes salvaged requests; the rest of the fleet serves
+    throughout.
+    """
+
+    def __init__(self, replicas: Sequence[Replica], *,
+                 prefill_replicas: Sequence[PrefillReplica] = (),
+                 prefill_threshold: Optional[int] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 tracer: Optional[Any] = None,
+                 recorder: Optional[Any] = None,
+                 logger: Optional[logging.Logger] = None) -> None:
+        if not replicas:
+            raise ValueError("FleetRouter needs at least one replica")
+        self.replicas = list(replicas)
+        self.prefill_replicas = list(prefill_replicas)
+        self.prefill_threshold = prefill_threshold
+        self._clock = clock
+        self._tracer = tracer
+        self._recorder = recorder
+        self._log = logger if logger is not None else LOG
+        self.counters = FleetCounters()
+        self._lock = threading.RLock()
+        self._results: List[Any] = []
+        self._retry: List[Request] = []
+        ids = [r.replica_id for r in self.replicas] \
+            + [r.replica_id for r in self.prefill_replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {ids}")
+        for rep in self.prefill_replicas:
+            rep._deliver = self._deliver
+
+    # -- submission ----------------------------------------------------
+
+    def submit(self, req: Request) -> Optional[Any]:
+        """Route a request.  ``None`` = accepted somewhere (its typed
+        result arrives via :meth:`drain_results`); otherwise the typed
+        fleet-level rejection (also recorded)."""
+        with self._lock:
+            self.counters.submitted += 1
+            return self._route(req)
+
+    def _route(self, req: Request) -> Optional[Any]:
+        if self._wants_prefill_lane(req):
+            target = self._least_loaded(self.prefill_replicas)
+            for rep in target:
+                if rep.submit(req):
+                    self._instant("fleet/route", rid=req.rid,
+                                  lane="prefill", replica=rep.replica_id)
+                    self.counters.routed += 1
+                    return None
+            # prefill lane saturated or dead: fall through — the decode
+            # replica prefills locally, correctness over disaggregation
+        return self._route_decode(req)
+
+    def _wants_prefill_lane(self, req: Request) -> bool:
+        if not self.prefill_replicas:
+            return False
+        if getattr(req, "_handoff", None) is not None:
+            return False   # already prefilled — decode lane only
+        if self.prefill_threshold is None:
+            return True
+        return int(req.prompt.shape[0]) >= self.prefill_threshold
+
+    def _route_decode(self, req: Request) -> Optional[Any]:
+        """Least-loaded healthy decode replica.  SERVING replicas first;
+        DEGRADED ones are a fallback (they still serve, at reduced
+        quality); DRAINING/dead never."""
+        serving = [r for r in self.replicas
+                   if r.health is HealthState.SERVING]
+        degraded = [r for r in self.replicas
+                    if r.health is HealthState.DEGRADED]
+        for rep in self._least_loaded(serving) + self._least_loaded(degraded):
+            if rep.submit(req):
+                self._instant("fleet/route", rid=req.rid, lane="decode",
+                              replica=rep.replica_id)
+                self.counters.routed += 1
+                return None
+        self.counters.shed_saturated += 1
+        self._instant("fleet/saturated", rid=req.rid)
+        rej = Overloaded(req.rid, self._clock(), reason="fleet saturated",
+                         meta={"replica": None, "level": None})
+        self._results.append(rej)
+        return rej
+
+    @staticmethod
+    def _least_loaded(reps: List[Any]) -> List[Any]:
+        return sorted(reps, key=lambda r: r.load)
+
+    def _deliver(self, kind: str, req: Request, payload: Any) -> None:
+        """Prefill-lane completion callback (runs on the prefill driver
+        thread when threaded — hence the lock)."""
+        with self._lock:
+            if kind == "shed":
+                self.counters.deadline_shed_prefill += 1
+                self._results.append(DeadlineExceeded(
+                    req.rid, self._clock(), stage="queue",
+                    meta={"replica": None, "level": None},
+                ))
+                return
+            handoff = payload
+            self.counters.handoffs += 1
+            self.counters.handoff_bytes += int(handoff.nbytes)
+            self._instant("fleet/handoff", rid=req.rid,
+                          nbytes=int(handoff.nbytes))
+            req._handoff = handoff
+            self._route_decode(req)
+
+    # -- supervision / self-healing ------------------------------------
+
+    def supervise(self) -> int:
+        """Probe every replica, heal the failed ones, re-route salvaged
+        and retry-pending requests.  Returns the number of heals."""
+        heals = 0
+        for rep in list(self.replicas) + list(self.prefill_replicas):
+            if rep.probe():
+                continue
+            heals += 1
+            self._heal(rep)
+        self._drain_retry()
+        return heals
+
+    def _heal(self, rep: Any) -> None:
+        reason = getattr(rep, "_dead", None) or "probe failure"
+        self._log.warning("fleet: healing replica %s (%s)",
+                          rep.replica_id, reason)
+        self._dump_flight(f"replica-death-{rep.replica_id}")
+        final, salvaged = rep.heal()
+        with self._lock:
+            self.counters.heals += 1
+            self.counters.requeued += len(salvaged)
+            self._results.extend(final)
+            self._retry.extend(salvaged)
+        if self._tracer is not None:
+            self._tracer.counter("fleet/heals", self.counters.heals,
+                                 replica=rep.replica_id)
+
+    def _drain_retry(self) -> None:
+        with self._lock:
+            retry, self._retry = self._retry, []
+            for req in retry:
+                # salvaged requests keep their remaining deadline; the
+                # route sheds or serves them like any fresh arrival, and
+                # saturation still yields a typed result — exactly once
+                self._route(req)
+
+    def _dump_flight(self, reason: str) -> Optional[str]:
+        rec = self._recorder if self._recorder is not None \
+            else active_recorder()
+        if rec is None:
+            return None
+        try:
+            return rec.dump(reason)
+        except Exception:
+            self._log.warning("fleet: flight dump failed", exc_info=True)
+            return None
+
+    # -- driving -------------------------------------------------------
+
+    def pump(self) -> bool:
+        """One supervision + serving beat: probe/heal, give every
+        non-threaded replica one round (threaded ones drive themselves),
+        collect results.  Returns whether any work remains."""
+        self.supervise()
+        for rep in self.prefill_replicas:
+            if not rep.threaded:
+                rep.pump()
+        for rep in self.replicas:
+            if not rep.threaded:
+                rep.pump()
+        self.collect()
+        return self.busy
+
+    def collect(self) -> None:
+        """Sweep every replica's typed results into the router's."""
+        for rep in self.replicas:
+            results = rep.drain_results()
+            if results:
+                with self._lock:
+                    self._results.extend(results)
+
+    @property
+    def busy(self) -> bool:
+        if self._retry:
+            return True
+        if any(rep.load > 0 for rep in self.prefill_replicas):
+            return True
+        for rep in self.replicas:
+            if rep._dead is not None:
+                # a threaded replica can die BETWEEN this pump's
+                # supervise and this check; its outstanding requests
+                # are salvage waiting on the next supervision beat —
+                # exiting now would drop them (exactly-once violation)
+                if rep._outstanding:
+                    return True
+            elif rep.load > 0:
+                return True
+        return False
+
+    def run_until_idle(self, max_rounds: int = 10_000,
+                       idle_s: float = 0.0005) -> List[Any]:
+        """Pump until no request is queued, in flight, or awaiting
+        retry anywhere in the fleet; returns the accumulated results.
+        ``idle_s`` lets threaded replicas' own rounds elapse without
+        burning ``max_rounds`` on busy-waiting."""
+        for _ in range(max_rounds):
+            busy = self.pump()
+            if all(rep.threaded
+                   for rep in self.replicas + self.prefill_replicas):
+                # all work happens on driver threads — pumping is just
+                # supervision, so pace it instead of busy-waiting
+                time.sleep(idle_s)
+            if not busy:
+                # settle: a threaded replica may be mid-round
+                time.sleep(idle_s)
+                if not self.busy:
+                    break
+        else:
+            raise RuntimeError(
+                f"run_until_idle: fleet still busy after {max_rounds} "
+                f"rounds"
+            )
+        self.collect()
+        return self.drain_results()
+
+    def drain_results(self) -> List[Any]:
+        with self._lock:
+            out, self._results = self._results, []
+        return out
+
+    # -- lifecycle / observability -------------------------------------
+
+    def start(self, idle_s: float = 0.001) -> None:
+        """Thread-back every replica (prefill + decode)."""
+        for rep in list(self.prefill_replicas) + list(self.replicas):
+            rep.start(idle_s)
+
+    def stop(self) -> None:
+        for rep in list(self.prefill_replicas) + list(self.replicas):
+            rep.stop()
+
+    def close(self) -> None:
+        for rep in list(self.prefill_replicas) + list(self.replicas):
+            rep.close()
+
+    def latency(self) -> ServeLatency:
+        """Fleet-wide latency view: every decode replica's histograms
+        merged into a fresh ``ServeLatency`` (replica state untouched)."""
+        agg = ServeLatency()
+        for rep in self.replicas:
+            try:
+                agg.merge(rep.loop.latency)
+            except Exception:
+                pass
+        return agg
+
+    def snapshot(self) -> Dict[str, float]:
+        return self.counters.snapshot()
+
+    def _instant(self, name: str, **fields: Any) -> None:
+        if self._tracer is not None:
+            self._tracer.instant(name, **fields)

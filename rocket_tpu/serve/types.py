@@ -25,9 +25,14 @@ from __future__ import annotations
 
 import dataclasses
 import enum
-from typing import Any, Optional
+from typing import Any, Dict, Optional
 
 import numpy as np
+
+# Identity of a serving replica in a fleet — rides every Result's
+# ``meta["replica"]`` so routing decisions are assertable without
+# reaching into router internals.
+ReplicaId = str
 
 
 class HealthState(enum.Enum):
@@ -80,10 +85,16 @@ class Request:
 @dataclasses.dataclass(frozen=True)
 class Result:
     """Base of the typed result family: which request, and when (on the
-    loop's clock) its fate was decided."""
+    loop's clock) its fate was decided.
+
+    ``meta`` records WHERE the fate was decided: the serving replica's
+    :data:`ReplicaId` and its degradation level at completion
+    (``{"replica": ..., "level": ...}``).  A fleet-level rejection (no
+    replica ever owned the request) carries ``replica=None``."""
 
     rid: Any
     finished_at: float
+    meta: Optional[Dict[str, Any]] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -18,7 +18,16 @@ Three fault families, matching how TPU training actually dies:
   :class:`StuckStepInjector` wedges scheduled ``ContinuousBatcher.step``
   calls (driving the serve watchdog's trip-and-rebuild path), and
   :func:`bursty_arrivals` builds the overload arrival schedules the
-  admission-control tests replay.
+  admission-control tests replay;
+- **fleet faults**: :class:`ReplicaKillInjector` raises
+  :class:`ReplicaKilled` out of scheduled ``ServingLoop.run_round``
+  calls (the in-process stand-in for a replica process dying — drives
+  the router's salvage-and-rebuild path), :class:`FlakyReplicaProxy`
+  fails scheduled health probes WITHOUT any exception (drives the
+  graceful drain-and-rebuild path), and :class:`SlowPrefillInjector`
+  stretches long-prompt prefills on a ``ContinuousBatcher`` (the
+  deterministic stand-in for the prefill cost the prefill/decode lane
+  split exists to absorb).
 
 Everything here is deterministic (iteration- or call-indexed, never
 random) so chaos tests replay exactly.
@@ -164,6 +173,133 @@ class StuckStepInjector:
             object.__setattr__(self, "hangs", self.hangs + 1)
             self._sleep(self._hang_s)
         return self._bat.step()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(object.__getattribute__(self, "_bat"), name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in self._OWN:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._bat, name, value)
+
+
+class ReplicaKilled(RuntimeError):
+    """What a dead replica looks like from inside the process: the next
+    interaction with its loop raises.  (A real process-backed replica
+    death surfaces as a broken IPC channel — same shape, different
+    transport.)"""
+
+
+class ReplicaKillInjector:
+    """Proxy a ``ServingLoop`` and kill scheduled ``run_round()`` calls.
+
+    ``kill_on`` indexes the run_round-call sequence through this proxy
+    (0 = first round); a scheduled call raises :class:`ReplicaKilled`
+    BEFORE delegating, so the wrapped loop's state — queue and in-flight
+    rows — is intact at death, exactly the situation replica salvage
+    must handle (nothing was lost, everything must be re-routed).
+
+    Everything else delegates to the wrapped loop, so the proxy drops
+    into any ``loop_factory``.
+    """
+
+    _OWN = ("_loop", "_kill_on", "rounds", "kills")
+
+    def __init__(self, loop: Any, kill_on: Iterable[int] = (0,)) -> None:
+        object.__setattr__(self, "_loop", loop)
+        object.__setattr__(self, "_kill_on",
+                           set(int(i) for i in kill_on))
+        object.__setattr__(self, "rounds", 0)  # run_round() calls seen
+        object.__setattr__(self, "kills", 0)   # calls actually killed
+
+    def run_round(self) -> bool:
+        pos = self.rounds
+        object.__setattr__(self, "rounds", pos + 1)
+        if pos in self._kill_on:
+            object.__setattr__(self, "kills", self.kills + 1)
+            raise ReplicaKilled(f"injected replica death (round #{pos})")
+        return self._loop.run_round()
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(object.__getattribute__(self, "_loop"), name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in self._OWN:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._loop, name, value)
+
+
+class FlakyReplicaProxy:
+    """Proxy a ``ServingLoop`` and fail scheduled health probes.
+
+    Exposes ``probe_healthy()`` — the duck-typed hook a fleet
+    ``Replica.probe`` consults — returning ``False`` on the probe
+    indexes in ``fail_on`` (0 = first probe through this proxy).  No
+    exception is ever raised: this drives the GRACEFUL decommission
+    path, where supervision drains and rebuilds a replica that still
+    answers but reports itself unhealthy.
+    """
+
+    _OWN = ("_loop", "_fail_on", "probes")
+
+    def __init__(self, loop: Any, fail_on: Iterable[int] = (0,)) -> None:
+        object.__setattr__(self, "_loop", loop)
+        object.__setattr__(self, "_fail_on",
+                           set(int(i) for i in fail_on))
+        object.__setattr__(self, "probes", 0)
+
+    def probe_healthy(self) -> bool:
+        pos = self.probes
+        object.__setattr__(self, "probes", pos + 1)
+        return pos not in self._fail_on
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(object.__getattribute__(self, "_loop"), name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in self._OWN:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._loop, name, value)
+
+
+class SlowPrefillInjector:
+    """Proxy a ``ContinuousBatcher`` and stretch long-prompt prefills.
+
+    Prompts of length >= ``min_len`` sleep ``delay_s`` before their
+    prefill (``admit()`` and ``prefill_handoff()`` alike) — the
+    deterministic stand-in for the real prefill cost of a long prompt,
+    scaled so CPU-proxy tests can observe the stall.  Handoff IMPORTS
+    (``admit_prefilled``) are never slowed: they are cheap by design,
+    which is the entire point of the prefill/decode lane split this
+    injector exists to demonstrate.
+    """
+
+    _OWN = ("_bat", "_delay_s", "_min_len", "_sleep", "stalls")
+
+    def __init__(self, batcher: Any, delay_s: float = 0.25,
+                 min_len: int = 0, sleep: Any = time.sleep) -> None:
+        object.__setattr__(self, "_bat", batcher)
+        object.__setattr__(self, "_delay_s", float(delay_s))
+        object.__setattr__(self, "_min_len", int(min_len))
+        object.__setattr__(self, "_sleep", sleep)
+        object.__setattr__(self, "stalls", 0)
+
+    def _maybe_stall(self, prompt_row: Any) -> None:
+        plen = int(np.asarray(prompt_row).reshape(1, -1).shape[1])
+        if plen >= self._min_len:
+            object.__setattr__(self, "stalls", self.stalls + 1)
+            self._sleep(self._delay_s)
+
+    def admit(self, row: int, prompt_row: Any, **kw: Any) -> None:
+        self._maybe_stall(prompt_row)
+        return self._bat.admit(row, prompt_row, **kw)
+
+    def prefill_handoff(self, prompt_row: Any, **kw: Any) -> Any:
+        self._maybe_stall(prompt_row)
+        return self._bat.prefill_handoff(prompt_row, **kw)
 
     def __getattr__(self, name: str) -> Any:
         return getattr(object.__getattribute__(self, "_bat"), name)

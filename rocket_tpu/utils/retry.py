@@ -31,6 +31,7 @@ import random
 import time
 from typing import Any, Callable, Optional, Tuple, Type
 
+from rocket_tpu.observe.trace import counter as _trace_counter
 from rocket_tpu.utils.logging import get_logger
 
 _logger = get_logger("retry")
@@ -50,6 +51,8 @@ def retry_call(
     retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON,
     logger: Any = None,
     clock: Callable[[], float] = time.monotonic,
+    name: Optional[str] = None,
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
     **kwargs: Any,
 ) -> Any:
     """Call ``fn(*args, **kwargs)``, retrying ``retry_on`` failures.
@@ -61,10 +64,18 @@ def retry_call(
     remain.  ``deadline`` (absolute on ``clock``, ``None`` = none) is the
     caller's own deadline: a backoff that would finish at or past it raises
     the last exception immediately — retries never outlive the caller.
+
+    Each SCHEDULED retry (one that will actually sleep and re-attempt) is
+    observable two ways: ``on_retry(attempt, exc, delay)`` fires with the
+    1-based failed-attempt number, and a ``retry/<name>/attempts`` counter
+    lands in the process tracer (``name`` defaults to ``fn.__name__``) —
+    so retry storms show up in Chrome-trace dumps next to the spans they
+    delayed instead of staying invisible in logs.
     """
     if tries < 1:
         raise ValueError("tries must be >= 1")
     log = logger or _logger
+    label = name or getattr(fn, "__name__", "call")
     slept = 0.0
     for attempt in range(tries):
         try:
@@ -92,6 +103,9 @@ def retry_call(
                 "transient failure (attempt %d/%d, retrying in %.3fs): %s",
                 attempt + 1, tries, delay, exc,
             )
+            _trace_counter(f"retry/{label}/attempts", attempt + 1)
+            if on_retry is not None:
+                on_retry(attempt + 1, exc, delay)
             time.sleep(delay)
             slept += delay
     raise AssertionError("unreachable")  # pragma: no cover
@@ -106,6 +120,8 @@ def retrying(
     retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON,
     logger: Any = None,
     clock: Callable[[], float] = time.monotonic,
+    name: Optional[str] = None,
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
 ) -> Callable[[Callable[..., Any]], Callable[..., Any]]:
     """Decorator form of :func:`retry_call`."""
 
@@ -123,6 +139,8 @@ def retrying(
                 retry_on=retry_on,
                 logger=logger,
                 clock=clock,
+                name=name,
+                on_retry=on_retry,
                 **kwargs,
             )
 

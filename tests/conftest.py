@@ -44,6 +44,12 @@ def pytest_configure(config):
         "tracing: structured-tracing / flight-recorder tests "
         "(rocket_tpu.observe.trace|recorder; see docs/observability.md)",
     )
+    config.addinivalue_line(
+        "markers",
+        "fleet: multi-replica serving fleet tests (rocket_tpu.serve "
+        "router/fleet — routing, lane handoff, replica self-healing; "
+        "see docs/reliability.md; the thousand-request trace is slow)",
+    )
 
 
 @pytest.fixture(scope="session")

@@ -44,6 +44,7 @@ from rocket_tpu.serve import (
     ServingLoop,
 )
 from rocket_tpu.testing.chaos import (
+    FaultySource,
     SlowSource,
     StuckStepInjector,
     bursty_arrivals,
@@ -648,3 +649,125 @@ class TestChaosTrio:
         loop.close()
         assert isinstance(res, Completed) and res.via_beam
         assert beam_calls == [TOTAL - P]
+
+
+# -- fleet satellites: queue counters, result meta, clock jumps ----------
+
+
+class TestQueueTraceCounters:
+    def test_depth_and_oldest_age_emitted_on_change(self):
+        from rocket_tpu.observe.trace import Tracer
+
+        tracer = Tracer(capacity=64, enabled=True)
+        clk = FakeClock()
+        q = AdmissionQueue(4, name="r0", tracer=tracer, clock=clk)
+        q.offer(Request(rid=0, prompt=np.ones(4, np.int32)))
+        clk.tick(2.0)
+        q.offer(Request(rid=1, prompt=np.ones(4, np.int32)))
+        q.pop()
+
+        def series(name):
+            key = name.rsplit("/", 1)[-1]
+            return [e[5][key] for e in tracer.events() if e[1] == name]
+
+        assert series("serve/queue/r0/depth") == [1.0, 2.0, 1.0]
+        ages = series("serve/queue/r0/oldest_age_s")
+        # offer@t0, offer@t2 (head aged 2s), pop@t2 (new head age 0)
+        assert ages == [0.0, 2.0, 0.0]
+
+    def test_shed_observes_once(self):
+        from rocket_tpu.observe.trace import Tracer
+
+        tracer = Tracer(capacity=64, enabled=True)
+        clk = FakeClock()
+        q = AdmissionQueue(4, name="q1", tracer=tracer, clock=clk)
+        for i in range(3):
+            q.offer(Request(rid=i, prompt=np.ones(4, np.int32),
+                            deadline=1.0))
+        before = len([e for e in tracer.events()
+                      if e[1] == "serve/queue/q1/depth"])
+        clk.tick(5.0)
+        shed = q.shed_hopeless(clk(), 0.0)
+        assert len(shed) == 3
+        depth = [e[5]["depth"] for e in tracer.events()
+                 if e[1] == "serve/queue/q1/depth"]
+        assert len(depth) == before + 1 and depth[-1] == 0.0
+
+
+class TestResultMeta:
+    def test_completed_meta_carries_replica_and_level(self, models,
+                                                      prompts):
+        loop = ServingLoop(_factory(models), max_batch=B,
+                           queue_capacity=8, replica_id="r7")
+        assert loop.submit(Request(rid=0, prompt=prompts[0])) is None
+        (res,) = loop.run_until_idle()
+        loop.close()
+        assert isinstance(res, Completed)
+        assert res.meta == {"replica": "r7", "level": 0}
+
+    def test_rejection_meta(self, models, prompts):
+        loop = ServingLoop(_factory(models), max_batch=B,
+                           queue_capacity=8, replica_id="r8")
+        loop.drain()
+        rej = loop.submit(Request(rid=0, prompt=prompts[0]))
+        loop.close()
+        assert isinstance(rej, Overloaded)
+        assert rej.meta["replica"] == "r8"
+
+
+class TestClockJumpShedding:
+    def test_queued_deadlines_shed_after_wedge(self, models, prompts):
+        """A clock jump while the loop was wedged: queued entries whose
+        deadline passed meanwhile are shed as DeadlineExceeded
+        (stage='queue', never prefilled) on the FIRST round after
+        recovery; the in-flight deadline-free row still completes."""
+        clk = FakeClock()
+        loop = ServingLoop(_factory(models), max_batch=1,
+                           queue_capacity=8, clock=clk)
+        assert loop.submit(Request(rid=0, prompt=prompts[0])) is None
+        loop.run_round()                     # rid 0 is in flight
+        admitted_before = loop.counters.admitted
+        for i in (1, 2):
+            assert loop.submit(
+                Request(rid=i, prompt=prompts[i], deadline=clk() + 5.0)
+            ) is None
+
+        clk.tick(100.0)                      # the wedge: deadlines passed
+        loop.run_round()                     # first round after recovery
+
+        shed = [r for r in loop.drain_results()
+                if isinstance(r, DeadlineExceeded)]
+        assert sorted(r.rid for r in shed) == [1, 2]
+        assert all(r.stage == "queue" for r in shed)
+        assert all(r.tokens is None for r in shed)
+        # neither shed entry ever reached the batcher
+        assert loop.counters.admitted == admitted_before
+        assert loop.counters.shed_deadline == 2
+
+        results = loop.run_until_idle()
+        loop.close()
+        assert [r.rid for r in results] == [0]
+        assert isinstance(results[0], Completed)
+        assert np.array_equal(results[0].tokens,
+                              _oracle(models, prompts[0]))
+
+
+class TestRetryObservability:
+    def test_on_retry_hook_and_trace_counter(self):
+        from rocket_tpu.observe import trace
+
+        src = FaultySource([10, 20, 30], fail_on=(0,), times=2)
+        seen = []
+        trace.arm(128)
+        try:
+            value = retry_call(
+                src.__getitem__, 0, tries=5, base_delay=0.0,
+                name="fetch", on_retry=lambda a, e, d: seen.append(a),
+            )
+            events = [e for e in trace.get_tracer().events()
+                      if e[1] == "retry/fetch/attempts"]
+        finally:
+            trace.disarm()
+        assert value == 10
+        assert seen == [1, 2]
+        assert [e[5]["attempts"] for e in events] == [1.0, 2.0]
