@@ -241,12 +241,20 @@ class Launcher(Dispatcher):
         spec = getattr(self._runtime, "resume_spec", None)
         if spec is None:
             return  # resume('auto') with nothing on disk — fresh start
+        from rocket_tpu.persist import integrity
         from rocket_tpu.persist.orbax_io import default_io
 
         io = default_io()
         # The VERIFIED path from _resolve_resume_path — not the raw request
         # ('auto', or a corrupt dir that fell back to a sibling).
         path = str(spec.path)
+        # Elastic restore (ISSUE 8): a mesh-stamped snapshot may restore
+        # onto a different topology — the Modules derive CURRENT-mesh
+        # target shardings at materialization and orbax reshards in
+        # transit; the topology guard below relaxes to a logged
+        # transition.  Legacy (unstamped) snapshots keep the strict guard.
+        self._saved_mesh = integrity.manifest_mesh(path)
+        self._log_mesh_transition(self._saved_mesh, path)
         available = set(io.keys(path))
         if not self._resume_load_capsules:
             # Weights-only: leave resume_spec armed for Modules, skip the
@@ -283,16 +291,65 @@ class Launcher(Dispatcher):
         self, saved_procs: Optional[int], qualifier: str = ""
     ) -> None:
         """Topology guard, shared by both resume paths (reference
-        ``launcher.py:370-375``)."""
+        ``launcher.py:370-375``).
+
+        Mesh-stamped snapshots (manifest schema >= 2, ISSUE 8) carry
+        enough layout metadata to reshard on restore, so a process-count
+        change is an *elastic* resume: logged, not fatal — the real
+        legality check is per-leaf in ``integrity.check_reshard`` at
+        restore time.  Legacy snapshots (no ``mesh`` section) keep the
+        strict guard: without the saved layout we cannot prove the
+        reshard is sound.
+        """
         if (
-            saved_procs is not None
-            and int(saved_procs) != self._runtime.process_count
+            saved_procs is None
+            or int(saved_procs) == self._runtime.process_count
         ):
-            raise RuntimeError(
-                f"resume topology mismatch: checkpoint was written by "
-                f"{int(saved_procs)} processes, this run has "
-                f"{self._runtime.process_count}. Elastic resume is not "
-                f"supported{qualifier} (reference launcher.py:370-375)."
+            return
+        if self._saved_mesh is not None:
+            self._logger.warning(
+                "elastic resume%s: checkpoint written by %d processes "
+                "(%d devices, axes %s), this run has %d processes — "
+                "arrays reshard onto the current mesh at restore",
+                qualifier,
+                int(saved_procs),
+                self._saved_mesh.get("device_count", -1),
+                self._saved_mesh.get("axes", {}),
+                self._runtime.process_count,
+            )
+            return
+        raise RuntimeError(
+            f"resume topology mismatch: checkpoint was written by "
+            f"{int(saved_procs)} processes, this run has "
+            f"{self._runtime.process_count}. Elastic resume is not "
+            f"supported{qualifier} for snapshots without a manifest "
+            f"mesh section (re-save with this version to stamp one; "
+            f"reference launcher.py:370-375)."
+        )
+
+    def _log_mesh_transition(
+        self, mesh_meta: Optional[dict], path: str
+    ) -> None:
+        """Announce a cross-mesh restore (saved axes != current mesh) so
+        an elastic transition is visible in the run log."""
+        if mesh_meta is None:
+            return
+        mesh = getattr(self._runtime, "mesh", None)
+        if mesh is None:
+            return
+        current = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+        saved = {
+            str(k): int(v) for k, v in (mesh_meta.get("axes") or {}).items()
+        }
+        if saved and saved != current:
+            self._logger.warning(
+                "elastic restore from %s: saved mesh %s (%s devices) -> "
+                "current mesh %s (%s devices)",
+                path,
+                saved,
+                mesh_meta.get("device_count", "?"),
+                current,
+                mesh.devices.size,
             )
 
     # -- the run -------------------------------------------------------------
@@ -392,6 +449,9 @@ class Launcher(Dispatcher):
     # -- state ---------------------------------------------------------------
 
     _saved_num_procs: Optional[int] = None
+    # The resumed snapshot's manifest "mesh" section (None = legacy
+    # snapshot, strict topology guard).
+    _saved_mesh: Optional[dict] = None
 
     def state_dict(self) -> Attributes:
         # The running epoch: resume re-enters it, and the Dataset's

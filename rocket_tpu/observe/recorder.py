@@ -101,6 +101,46 @@ _ACTIVE: Optional[FlightRecorder] = None
 # reaches the Checkpointer's snapshot path (or vice versa, whichever
 # installed first).
 _PREV_SIGTERM = {"handler": None}
+# One SIGTERM delivery walks a chain of handlers (ours, the Checkpointer's
+# orchestrator, whatever was installed before either) — and BOTH ends of
+# the chain want the recorder dumped first.  The chain state makes the
+# dump once-per-delivery regardless of install order: every handler enters
+# sigterm_chain(), the first dump_for_sigterm() wins, and the flag resets
+# when the outermost handler exits (ISSUE 8 satellite: deterministic
+# layering — recorder dump first, emergency flush second, previous
+# handler last).
+_CHAIN = {"depth": 0, "dumped": False}
+
+
+class sigterm_chain:
+    """Context manager scoping one SIGTERM handler invocation; nesting
+    (a chained handler inside another) shares one dump budget."""
+
+    def __enter__(self) -> "sigterm_chain":
+        _CHAIN["depth"] += 1
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        _CHAIN["depth"] -= 1
+        if _CHAIN["depth"] <= 0:
+            _CHAIN["depth"] = 0
+            _CHAIN["dumped"] = False
+
+
+def dump_for_sigterm() -> Optional[str]:
+    """Dump the active recorder for the current SIGTERM delivery —
+    idempotent within one handler chain (one dump attempt per delivery,
+    however many chained handlers ask)."""
+    if _CHAIN["dumped"]:
+        return None
+    _CHAIN["dumped"] = True
+    rec = _ACTIVE
+    if rec is None:
+        return None
+    try:
+        return rec.dump("sigterm")
+    except Exception:
+        return None  # a failing dump must never mask the preemption path
 
 
 def active_recorder() -> Optional[FlightRecorder]:
@@ -127,15 +167,11 @@ def uninstall() -> None:
 
 
 def _on_sigterm(signum: int, frame: Any) -> None:
-    rec = _ACTIVE
-    if rec is not None:
-        try:
-            rec.dump("sigterm")
-        except Exception:
-            pass  # a failing dump must never mask the preemption path
-    prev = _PREV_SIGTERM["handler"]
-    if callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN):
-        prev(signum, frame)
+    with sigterm_chain():
+        dump_for_sigterm()
+        prev = _PREV_SIGTERM["handler"]
+        if callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN):
+            prev(signum, frame)
 
 
 def _install_sigterm() -> None:

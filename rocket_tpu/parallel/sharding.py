@@ -105,25 +105,65 @@ class ShardingRules:
 DEFAULT_RULES = ShardingRules()
 
 
-def tree_shardings(mesh: Mesh, tree: Any, rules: ShardingRules = DEFAULT_RULES) -> Any:
+def tree_shardings(
+    mesh: Mesh,
+    tree: Any,
+    rules: ShardingRules = DEFAULT_RULES,
+    shapes: Any = None,
+) -> Any:
     """Map a pytree of logical-axis tuples (as produced by
     ``nn.with_partitioning`` metadata / ``nn.get_partition_spec``) to a pytree
-    of NamedShardings."""
+    of NamedShardings.
 
-    def leaf_to_sharding(leaf: Any) -> Any:
+    Every error names the offending leaf's tree path — a bad annotation in
+    a 400-leaf model must say *which* leaf, not just *what* (an opaque
+    ``KeyError: 'mlp'`` cost a debugging afternoon once).  ``shapes`` is an
+    optional matching pytree of array shapes (tuples); when given, a spec
+    with more entries than the leaf has dims is rejected here rather than
+    as a GSPMD lowering error later.
+    """
+    mesh_axes = set(str(name) for name in mesh.shape)
+    is_leaf = lambda x: x is None or isinstance(x, (tuple, list, PartitionSpec))
+
+    def leaf_to_sharding(path: Any, leaf: Any, shape: Any = None) -> Any:
+        where = jax.tree_util.keystr(path) or "<root>"
         if isinstance(leaf, PartitionSpec):
-            return NamedSharding(mesh, leaf)
-        if leaf is None:
-            return replicated(mesh)
-        if isinstance(leaf, (tuple, list)):
-            return rules.sharding(mesh, *leaf)
-        raise TypeError(f"cannot interpret sharding annotation {leaf!r}")
+            spec = leaf
+        elif leaf is None:
+            spec = P()
+        elif isinstance(leaf, (tuple, list)):
+            try:
+                spec = rules.spec(*leaf)
+            except KeyError as exc:
+                raise KeyError(f"leaf {where}: {exc.args[0]}") from None
+        else:
+            raise TypeError(
+                f"leaf {where}: cannot interpret sharding annotation {leaf!r}"
+            )
+        for entry in spec:
+            for axis in entry if isinstance(entry, (tuple, list)) else (entry,):
+                if axis is not None and str(axis) not in mesh_axes:
+                    raise ValueError(
+                        f"leaf {where}: PartitionSpec {spec} names mesh axis "
+                        f"{axis!r} absent from mesh axes "
+                        f"{tuple(dict(mesh.shape))} — build the mesh with "
+                        f"that axis (size 1 is free) or remap the logical "
+                        f"axis in ShardingRules"
+                    )
+        if shape is not None and len(spec) > len(tuple(shape)):
+            raise ValueError(
+                f"leaf {where}: PartitionSpec {spec} has {len(spec)} entries "
+                f"but the array is rank {len(tuple(shape))} "
+                f"(shape {tuple(shape)})"
+            )
+        return NamedSharding(mesh, spec)
 
-    return jax.tree_util.tree_map(
-        leaf_to_sharding,
-        tree,
-        is_leaf=lambda x: x is None
-        or isinstance(x, (tuple, list, PartitionSpec)),
+    if shapes is not None:
+        return jax.tree_util.tree_map_with_path(
+            leaf_to_sharding, tree, shapes, is_leaf=is_leaf
+        )
+    return jax.tree_util.tree_map_with_path(
+        leaf_to_sharding, tree, is_leaf=is_leaf
     )
 
 

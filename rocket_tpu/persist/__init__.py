@@ -1,7 +1,11 @@
 from rocket_tpu.persist.checkpoint import Checkpointer
+from rocket_tpu.persist.emergency import EmergencyTier
 from rocket_tpu.persist.integrity import (
+    TopologyMismatch,
     build_manifest,
+    check_reshard,
     latest_valid,
+    manifest_mesh,
     quarantine,
     read_manifest,
     resolve_restore_path,
@@ -12,9 +16,13 @@ from rocket_tpu.persist.orbax_io import CheckpointIO, default_io
 __all__ = [
     "Checkpointer",
     "CheckpointIO",
+    "EmergencyTier",
+    "TopologyMismatch",
     "default_io",
     "build_manifest",
+    "check_reshard",
     "latest_valid",
+    "manifest_mesh",
     "quarantine",
     "read_manifest",
     "resolve_restore_path",

@@ -29,7 +29,7 @@ from typing import Any, Optional
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
-from rocket_tpu.persist import integrity
+from rocket_tpu.persist import emergency, integrity
 from rocket_tpu.persist.orbax_io import default_io
 
 # Set by the SIGTERM handler; checked at every iteration boundary.  TPU pod
@@ -37,12 +37,36 @@ from rocket_tpu.persist.orbax_io import default_io
 # path on TPU (SURVEY §5.3).
 _preempted = threading.Event()
 
+# Re-entrancy latch (ISSUE 8 satellite): a second SIGTERM landing while the
+# first delivery's handler chain is still running only re-arms the
+# preemption flag — the dump/flush sequence runs once per delivery.
+_HANDLING = {"active": False}
 
-def _on_sigterm(signum, frame):  # pragma: no cover - exercised via raise_signal
+
+def _on_sigterm(signum, frame):
+    """The preemption orchestrator — deterministic layering regardless of
+    which subsystem hooked SIGTERM first: (1) flight-recorder dump, (2)
+    emergency checkpoint flush, (3) whatever handler was installed before
+    us.  The recorder's chain state makes step (1) once-per-delivery even
+    when its own handler sits elsewhere in the chain."""
     _preempted.set()
-    prev = _PREV_HANDLER.get("handler")
-    if callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN):
-        prev(signum, frame)
+    if _HANDLING["active"]:
+        return  # re-entrant delivery: one flush, latch already set
+    _HANDLING["active"] = True
+    try:
+        # Lazy import: untraced/unobserved runs must not pay for observe at
+        # module import; setup() pre-warms it so this is a dict lookup at
+        # signal time.
+        from rocket_tpu.observe import recorder as flightrec
+
+        with flightrec.sigterm_chain():
+            flightrec.dump_for_sigterm()
+            emergency.flush_active("sigterm")
+            prev = _PREV_HANDLER.get("handler")
+            if callable(prev) and prev not in (signal.SIG_DFL, signal.SIG_IGN):
+                prev(signum, frame)
+    finally:
+        _HANDLING["active"] = False
 
 
 _PREV_HANDLER: dict = {}
@@ -68,6 +92,8 @@ class Checkpointer(Capsule):
         keep_last: Optional[int] = None,
         save_on_cycle_end: bool = False,
         save_on_preemption: bool = True,
+        emergency_every: Optional[int] = None,
+        emergency_dir_format: str = "emergency/{:06d}",
         track_metric: Optional[str] = None,
         keep_best: int = 1,
         best_mode: str = "max",
@@ -79,11 +105,20 @@ class Checkpointer(Capsule):
         super().__init__(statefull=statefull, priority=priority, logger=logger)
         if save_every is not None and save_every < 1:
             raise ValueError("save_every must be >= 1 (or None to disable)")
+        if emergency_every is not None and emergency_every < 1:
+            raise ValueError(
+                "emergency_every must be >= 1 (or None to disable)"
+            )
         if best_mode not in ("max", "min"):
             raise ValueError(f"best_mode must be 'max'/'min', got {best_mode!r}")
         if keep_best < 1:
             raise ValueError("keep_best must be >= 1")
         self._save_every = int(save_every) if save_every is not None else None
+        self._emergency_every = (
+            int(emergency_every) if emergency_every is not None else None
+        )
+        self._emergency_format = emergency_dir_format
+        self._etier: Optional[emergency.EmergencyTier] = None
         self._format = output_dir_format
         self._keep_last = keep_last
         self._save_on_cycle_end = save_on_cycle_end
@@ -102,6 +137,11 @@ class Checkpointer(Capsule):
 
     def setup(self, attrs: Optional[Attributes] = None) -> None:
         super().setup(attrs)
+        # A fresh launch must not inherit the previous run's preemption
+        # latch: after a HARD preemption (SIGTERM but no grace window —
+        # the orderly branch that clears the latch never ran) a resumed
+        # run in the same process would otherwise stop at iteration 0.
+        _preempted.clear()
         if self._runtime.project_dir is None:
             raise RuntimeError(
                 "Checkpointer needs a project dir — give the Launcher a tag "
@@ -131,6 +171,14 @@ class Checkpointer(Capsule):
                 best += self._scan_best(root)
             best.sort(key=lambda t: t[0], reverse=self._best_mode == "max")
             self._best = best[: self._keep_best]
+        if self._emergency_every is not None:
+            self._etier = emergency.activate(
+                emergency.EmergencyTier(
+                    self._runtime.project_dir,
+                    dir_format=self._emergency_format,
+                    logger=self._logger,
+                )
+            )
         if (
             self._save_on_preemption
             and threading.current_thread() is threading.main_thread()
@@ -139,6 +187,10 @@ class Checkpointer(Capsule):
             # First Checkpointer in the process installs (and later restores)
             # the handler; further instances share it — re-installing would
             # make _on_sigterm its own "previous handler" and recurse.
+            # Warm the observe import so the handler's lazy import is a
+            # sys.modules lookup at signal time, never real import work.
+            import rocket_tpu.observe.recorder  # noqa: F401
+
             _PREV_HANDLER["handler"] = signal.getsignal(signal.SIGTERM)
             signal.signal(signal.SIGTERM, _on_sigterm)
             self._installed_handler = True
@@ -157,7 +209,7 @@ class Checkpointer(Capsule):
         root a snapshot was written under, or None on no match."""
         import re
 
-        for fmt in (self._format, self._best_format):
+        for fmt in (self._format, self._best_format, self._emergency_format):
             parts = self._format_parts(fmt)
             if parts is None:
                 continue
@@ -207,6 +259,10 @@ class Checkpointer(Capsule):
             )
             self.save()
             default_io().wait()
+            if self._etier is not None:
+                # The durable grace-window snapshot above supersedes any
+                # staged (strictly older) emergency capture.
+                self._etier.discard()
             self._iter_idx += 1
             if attrs is not None and attrs.looper is not None:
                 attrs.looper.terminate = True
@@ -224,6 +280,22 @@ class Checkpointer(Capsule):
             and (self._iter_idx + 1) % self._save_every == 0
         ):
             self.save()
+        if (
+            self._emergency_every is not None
+            and (self._iter_idx + 1) % self._emergency_every == 0
+        ):
+            # Stage (don't write) the post-step state: async host readback,
+            # zero device syncs on the happy path — the SIGTERM orchestrator
+            # flushes the newest stage to disk inside the grace window.
+            items = self._collect_items()
+            if items:
+                self._etier.capture(
+                    items,
+                    iter_idx=self._iter_idx,
+                    epoch_idx=self._epoch_idx,
+                    mesh=self._runtime.mesh,
+                    rules=getattr(self._runtime, "rules", None),
+                )
         self._iter_idx += 1
 
     def reset(self, attrs: Optional[Attributes] = None) -> None:
@@ -248,6 +320,13 @@ class Checkpointer(Capsule):
         # surplus dir retained as crash insurance during in-flight saves
         # (save() prunes before appending) can go now.
         self._prune()
+        if self._etier is not None:
+            # A clean teardown needs no emergency flush — whatever was
+            # staged is covered by the (now durable) final snapshot or by
+            # a deliberate end-of-run state.
+            self._etier.discard()
+            emergency.deactivate(self._etier)
+            self._etier = None
         if self._installed_handler:
             signal.signal(
                 signal.SIGTERM, _PREV_HANDLER.get("handler") or signal.SIG_DFL
@@ -265,16 +344,17 @@ class Checkpointer(Capsule):
             path = os.path.join(
                 self._runtime.project_dir, self._format.format(self._iter_idx)
             )
-        items = {}
-        for capsule in self._runtime.checkpointables:
-            state = capsule.state_dict()
-            if state:
-                items[capsule._ckpt_key] = state
+        items = self._collect_items()
         if not items:
             self._logger.warning("nothing to checkpoint — no stateful state yet")
             return path
+        # Mesh-stamped manifest (ISSUE 8): the snapshot records its saving
+        # topology + rules table, making it elastic-restorable onto a
+        # different mesh.
         manifest = integrity.build_manifest(
             items, iter_idx=self._iter_idx, epoch_idx=self._epoch_idx,
+            mesh=self._runtime.mesh,
+            rules=getattr(self._runtime, "rules", None),
         )
         # Prune BEFORE appending the new path, so retention counts only
         # already-issued saves: the newest tracked entry always exists on
@@ -294,6 +374,16 @@ class Checkpointer(Capsule):
         default_io().save(path, items, force=True, manifest=manifest)
         self._logger.info("checkpoint -> %s", path)
         return path
+
+    def _collect_items(self) -> dict:
+        """Every registered capsule's state, keyed by its registry key —
+        shared by the durable save path and the emergency capture."""
+        items = {}
+        for capsule in self._runtime.checkpointables:
+            state = capsule.state_dict()
+            if state:
+                items[capsule._ckpt_key] = state
+        return items
 
     # -- best-k by metric ----------------------------------------------------
 

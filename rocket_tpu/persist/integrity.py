@@ -21,6 +21,15 @@ on top of :class:`~rocket_tpu.persist.orbax_io.CheckpointIO`:
 4. :func:`latest_valid` scans newest-to-oldest and returns the first
    snapshot that verifies, quarantining broken ones by renaming to
    ``<name>.corrupt`` so retention globs and future scans skip them.
+
+Elastic restore (ISSUE 8): the manifest additionally records the **saving
+topology** — mesh axis names/sizes, device count, the run's
+:class:`~rocket_tpu.parallel.sharding.ShardingRules` table, and each leaf's
+saved ``PartitionSpec`` — so a snapshot taken on mesh A can be validated
+against (and restored onto) a different mesh B.  :func:`check_reshard`
+is the restore-time gate: a leaf that cannot be legally laid out on the
+current mesh raises a typed :class:`TopologyMismatch` naming the leaf and
+the remedy, instead of silently mis-placing it.
 """
 
 from __future__ import annotations
@@ -39,10 +48,23 @@ from rocket_tpu.utils.logging import get_logger
 
 _logger = get_logger("integrity")
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2  # 2: + "mesh" topology section and per-leaf "spec"
 MANIFEST_NAME = "manifest.json"
 COMMIT_MARKER = "_COMMITTED"
 CORRUPT_SUFFIX = ".corrupt"
+EMERGENCY_MARKER = "_EMERGENCY"
+
+# Snapshot subdirectories resume("auto") elects from: the Checkpointer's
+# durable cadence AND the preemption-grade emergency tier (persist.emergency)
+# — (iter, mtime) ordering decides between them.
+DEFAULT_SUBDIRS = ("weights", "emergency")
+
+
+class TopologyMismatch(RuntimeError):
+    """A checkpoint leaf cannot be legally laid out on the current mesh.
+
+    Raised at restore time — loudly, with the leaf path and a remedy —
+    instead of letting jax/orbax mis-place or opaquely reject the leaf."""
 
 
 # -- manifest construction ---------------------------------------------------
@@ -65,6 +87,17 @@ def _canon_path(path: Any) -> str:
     return "/".join(parts)
 
 
+def _leaf_spec(leaf: Any) -> Optional[List[Any]]:
+    """The leaf's saved PartitionSpec as a JSON-able list (``None`` entries
+    replicate, strings name one mesh axis, lists name several) — ``None``
+    for host leaves / non-named shardings."""
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is None:
+        return None
+    return [list(e) if isinstance(e, (tuple, list)) else e for e in spec]
+
+
 def _leaf_record(path: Any, leaf: Any) -> Dict[str, Any]:
     record: Dict[str, Any] = {"path": _canon_path(path)}
     shape = getattr(leaf, "shape", None)
@@ -73,8 +106,30 @@ def _leaf_record(path: Any, leaf: Any) -> Dict[str, Any]:
     record["shape"] = [int(s) for s in shape]
     record["dtype"] = str(np.asarray(leaf).dtype if not hasattr(leaf, "dtype")
                           else leaf.dtype)
+    record["spec"] = _leaf_spec(leaf)
     record["crc32"] = _leaf_crc32(leaf)
     return record
+
+
+def _mesh_section(mesh: Any, rules: Any) -> Optional[Dict[str, Any]]:
+    """The manifest ``mesh`` section: saving topology + logical-axis table.
+
+    What elastic restore needs to judge a snapshot: which named axes
+    existed (and their sizes), how many devices the mesh spanned, and the
+    logical→mesh mapping the run's specs were derived through."""
+    if mesh is None:
+        return None
+    section: Dict[str, Any] = {
+        "axes": {str(name): int(size) for name, size in dict(mesh.shape).items()},
+        "device_count": int(mesh.devices.size),
+    }
+    if rules is not None:
+        table = rules.table() if hasattr(rules, "table") else dict(rules)
+        section["rules"] = [
+            [name, list(axes) if isinstance(axes, (tuple, list)) else axes]
+            for name, axes in table.items()
+        ]
+    return section
 
 
 def _leaf_crc32(leaf: Any) -> Optional[int]:
@@ -96,11 +151,16 @@ def build_manifest(
     iter_idx: Optional[int] = None,
     epoch_idx: Optional[int] = None,
     checksums: bool = True,
+    mesh: Any = None,
+    rules: Any = None,
 ) -> Dict[str, Any]:
     """Manifest dict for a composite snapshot about to be saved.
 
     ``checksums=False`` skips the per-leaf crc32 (and its device sync) for
-    latency-critical saves; structure is always recorded.
+    latency-critical saves; structure is always recorded.  ``mesh`` (+
+    optional ``rules``) stamps the saving topology so the snapshot becomes
+    elastic-restorable (schema 2); without it the snapshot restores only
+    onto an identical topology (the schema-1 contract).
     """
     manifest: Dict[str, Any] = {
         "schema": SCHEMA_VERSION,
@@ -109,6 +169,9 @@ def build_manifest(
         "num_procs": jax.process_count(),
         "items": {},
     }
+    mesh_meta = _mesh_section(mesh, rules)
+    if mesh_meta is not None:
+        manifest["mesh"] = mesh_meta
     for key, tree in items.items():
         leaves = jax.tree_util.tree_leaves_with_path(tree)
         if checksums:
@@ -177,6 +240,13 @@ def verify(path: str, deep: bool = False) -> Tuple[bool, str]:
     items = manifest.get("items")
     if not isinstance(items, dict) or not items:
         return False, "corrupt: manifest lists no items"
+    mesh = manifest.get("mesh")
+    if mesh is not None and not (
+        isinstance(mesh, dict)
+        and isinstance(mesh.get("axes"), dict)
+        and isinstance(mesh.get("device_count"), int)
+    ):
+        return False, "corrupt: malformed mesh section"
     for key in items:
         if not os.path.isdir(os.path.join(path, key)):
             return False, f"corrupt: item {key!r} directory missing"
@@ -229,6 +299,86 @@ def _verify_deep(path: str, items: Dict[str, Any]) -> Tuple[bool, str]:
     return True, "ok"
 
 
+# -- elastic restore validation ----------------------------------------------
+
+
+def manifest_mesh(path: str) -> Optional[Dict[str, Any]]:
+    """The snapshot's recorded ``mesh`` section (saving topology), or
+    ``None`` for legacy / unstamped snapshots."""
+    manifest = read_manifest(path)
+    if not isinstance(manifest, dict):
+        return None
+    mesh = manifest.get("mesh")
+    return mesh if isinstance(mesh, dict) else None
+
+
+def check_reshard(
+    manifest: Dict[str, Any], targets: Dict[str, Any]
+) -> None:
+    """Restore-time gate: every target leaf must be legally placeable on
+    its own (current-mesh) sharding, and structurally match what the
+    manifest says was saved.  Raises :class:`TopologyMismatch` naming the
+    first offending leaf — with the remedy — instead of letting a
+    cross-mesh restore silently mis-place it.
+
+    Legality per leaf: (a) recorded and target shapes agree (a shape drift
+    is a model change, not a mesh change); (b) every mesh axis named by
+    the target's PartitionSpec exists on the target's mesh; (c) the spec
+    does not have more entries than the leaf has dimensions.  Uneven
+    divisions (dim not divisible by the axis-size product) are legal —
+    GSPMD pads the ragged shard.
+    """
+    saved_mesh = manifest.get("mesh") if isinstance(manifest, dict) else None
+    saved_axes = (saved_mesh or {}).get("axes")
+    items = manifest.get("items", {}) if isinstance(manifest, dict) else {}
+    for key, target in targets.items():
+        if target is None:
+            continue
+        recorded = {
+            rec["path"]: rec
+            for rec in items.get(key, {}).get("structure", [])
+        }
+        if not recorded:
+            continue
+        for p, leaf in jax.tree_util.tree_leaves_with_path(target):
+            rec = recorded.get(_canon_path(p))
+            where = f"item {key!r} leaf {_canon_path(p)}"
+            shape = [int(s) for s in getattr(leaf, "shape", np.shape(leaf))]
+            if rec is not None and list(rec.get("shape", shape)) != shape:
+                raise TopologyMismatch(
+                    f"{where}: checkpoint holds shape {rec['shape']}, "
+                    f"restore target expects {shape} — that is a model "
+                    f"change, not a mesh change. Remedy: restore into the "
+                    f"saved architecture, or use a weights-only resume "
+                    f"into a matching subtree."
+                )
+            sharding = getattr(leaf, "sharding", None)
+            spec = getattr(sharding, "spec", None)
+            if sharding is None or spec is None:
+                continue
+            mesh_axes = {str(n) for n in dict(sharding.mesh.shape)}
+            for entry in spec:
+                names = entry if isinstance(entry, (tuple, list)) else (entry,)
+                for name in names:
+                    if name is not None and str(name) not in mesh_axes:
+                        raise TopologyMismatch(
+                            f"{where}: PartitionSpec names mesh axis "
+                            f"{name!r} which the current mesh lacks "
+                            f"(current axes {sorted(mesh_axes)}, saving "
+                            f"mesh had {saved_axes}). Remedy: build the "
+                            f"restore mesh with that axis (size 1 is "
+                            f"free), or remap the logical axis in "
+                            f"ShardingRules."
+                        )
+            if len(spec) > len(shape):
+                raise TopologyMismatch(
+                    f"{where}: PartitionSpec {tuple(spec)} has "
+                    f"{len(spec)} entries for a rank-{len(shape)} leaf. "
+                    f"Remedy: fix the partition rules for this leaf — a "
+                    f"spec may only constrain dimensions the leaf has."
+                )
+
+
 # -- quarantine + fallback ---------------------------------------------------
 
 
@@ -267,18 +417,35 @@ def _snapshot_dirs(root: str, subdir: str) -> List[Tuple[int, str]]:
     return found
 
 
+def _order_key(idx: int, path: str) -> Tuple[int, float]:
+    """``(iter, mtime)`` election key for a snapshot dir (ISSUE 8
+    satellite): the manifest's recorded ``iter_idx`` outranks the
+    directory name (a clock jump between runs can stamp a LATER run with a
+    smaller dir name), and mtime breaks iteration ties (e.g. an emergency
+    flush vs the durable save of the same step — the later write wins)."""
+    manifest = read_manifest(path)
+    iter_idx = manifest.get("iter_idx") if isinstance(manifest, dict) else None
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = 0.0
+    return (int(iter_idx) if iter_idx is not None else int(idx), mtime)
+
+
 def latest_valid(
     root: str,
-    subdirs: Tuple[str, ...] = ("weights",),
+    subdirs: Tuple[str, ...] = DEFAULT_SUBDIRS,
     deep: bool = False,
     do_quarantine: bool = True,
 ) -> Optional[str]:
     """Newest snapshot under ``root`` that verifies, scanning the versioned
     project layout (``root/v0,v1,…/<subdir>/<iter>`` — or ``root`` itself
-    when it has no ``v*`` children).  Broken candidates newer than the
-    first valid one are quarantined (main-process duty; pass
-    ``do_quarantine=False`` on other hosts and adopt host 0's answer via
-    a broadcast)."""
+    when it has no ``v*`` children).  Candidates are ordered by version,
+    then (iter, mtime) via :func:`_order_key` across ALL subdirs — so the
+    emergency tier wins exactly when it is newer than the last durable
+    save.  Broken candidates newer than the first valid one are
+    quarantined (main-process duty; pass ``do_quarantine=False`` on other
+    hosts and adopt host 0's answer via a broadcast)."""
     root = os.path.abspath(root)
     versions = []
     if os.path.isdir(root):
@@ -287,12 +454,12 @@ def latest_valid(
                 versions.append((int(name[1:]), os.path.join(root, name)))
     versions.sort(reverse=True)
     roots = [p for _, p in versions] or [root]
-    candidates: List[Tuple[Tuple[int, int], str]] = []
+    candidates: List[Tuple[Tuple[int, int, float], str]] = []
     for vi, vroot in enumerate(roots):
         for subdir in subdirs:
             for idx, path in _snapshot_dirs(vroot, subdir):
-                # newest version first, then newest iteration
-                candidates.append(((-vi, idx), path))
+                # newest version first, then newest (iter, mtime)
+                candidates.append(((-vi,) + _order_key(idx, path), path))
     candidates.sort(reverse=True)
     for _, path in candidates:
         ok, reason = verify(path, deep=deep)
@@ -337,7 +504,7 @@ def resolve_restore_path(
     if do_quarantine:
         quarantine(path, reason)
     fallbacks = [
-        (idx, p)
+        (_order_key(idx, p), p)
         for idx, p in _snapshot_dirs(os.path.dirname(parent),
                                      os.path.basename(parent))
         if os.path.basename(p) != name

@@ -159,6 +159,17 @@ class CheckpointIO:
         path = os.path.abspath(path)
         targets = targets or {}
         want = keys if keys is not None else self.keys(path)
+        # Elastic gate (ISSUE 8): a mesh-stamped snapshot may restore onto
+        # a different topology — validate every target leaf against the
+        # manifest FIRST so an illegal reshard fails loudly (typed
+        # TopologyMismatch with the leaf path + remedy) instead of
+        # surfacing as an opaque orbax/jax layout error mid-restore.
+        manifest = integrity.read_manifest(path)
+        if manifest is not None and manifest.get("mesh") is not None:
+            integrity.check_reshard(
+                manifest,
+                {key: targets[key] for key in want if key in targets},
+            )
         composite_args: Dict[str, Any] = {}
         for key in want:
             target = targets.get(key)

@@ -2,8 +2,10 @@
 
 from rocket_tpu.testing.chaos import (
     FaultySource,
+    HardPreemptionInjector,
     NaNInjector,
     SigtermInjector,
+    SimulatedKill,
     SlowSource,
     StuckStepInjector,
     bursty_arrivals,
@@ -12,8 +14,10 @@ from rocket_tpu.testing.chaos import (
 
 __all__ = [
     "FaultySource",
+    "HardPreemptionInjector",
     "NaNInjector",
     "SigtermInjector",
+    "SimulatedKill",
     "SlowSource",
     "StuckStepInjector",
     "bursty_arrivals",

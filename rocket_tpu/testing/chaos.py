@@ -413,6 +413,53 @@ class SigtermInjector(Capsule):
             signal.raise_signal(signal.SIGTERM)
 
 
+class SimulatedKill(RuntimeError):
+    """The process dying mid-step — no grace window, no orderly teardown.
+    Raised by :class:`HardPreemptionInjector` so a test can observe a
+    hard kill without actually losing the pytest process."""
+
+
+class HardPreemptionInjector(Capsule):
+    """SIGTERM followed by immediate death at iteration ``at_iter``.
+
+    :class:`SigtermInjector` models the *polite* preemption: the notice
+    arrives, the step loop reaches the Checkpointer's grace-window branch,
+    a full durable snapshot lands.  This injector models the brutal one —
+    the host is reclaimed before the grace window: the signal is raised
+    (so the handler chain runs — flight-recorder dump, emergency-tier
+    flush; Python delivers the handler at the next bytecode boundary,
+    i.e. before the next statement here), then :class:`SimulatedKill`
+    propagates out of the dispatcher so the Checkpointer's launch of this
+    iteration NEVER runs.  Whatever survives on disk is exactly what a
+    real hard preemption would leave: the emergency flush plus any older
+    durable snapshot.  Mount ABOVE the Checkpointer (priority > 100).
+    """
+
+    def __init__(
+        self,
+        at_iter: int,
+        priority: int = 150,
+        logger: Optional[Any] = None,
+    ) -> None:
+        super().__init__(statefull=False, priority=priority, logger=logger)
+        self._at_iter = int(at_iter)
+        self._iter = 0
+        self.fired = 0
+
+    def launch(self, attrs: Optional[Attributes] = None) -> None:
+        fire = self._iter == self._at_iter and not self.fired
+        self._iter += 1
+        if fire:
+            self.fired += 1
+            self._logger.warning(
+                "injecting hard preemption at iteration %d", self._iter - 1
+            )
+            signal.raise_signal(signal.SIGTERM)
+            raise SimulatedKill(
+                f"hard preemption at iteration {self._iter - 1}"
+            )
+
+
 class NaNInjector(Capsule):
     """Overwrite every float leaf of ``attrs.batch`` with NaN on the listed
     training iterations (0-indexed, counted across cycles).  Mount it

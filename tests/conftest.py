@@ -50,6 +50,12 @@ def pytest_configure(config):
         "router/fleet — routing, lane handoff, replica self-healing; "
         "see docs/reliability.md; the thousand-request trace is slow)",
     )
+    config.addinivalue_line(
+        "markers",
+        "elastic: elastic-restore / preemption-persistence tests "
+        "(mesh-stamped manifests, reshard-on-restore, emergency tier; "
+        "see docs/reliability.md)",
+    )
 
 
 @pytest.fixture(scope="session")

@@ -13,6 +13,19 @@ from rocket_tpu.observe.backends import MemoryBackend
 from test_pipeline import MLP, synthetic_classification
 
 
+def _strip_mesh(ckpt):
+    """Rewrite a snapshot's manifest without its 'mesh' section — the
+    schema-1 (pre-elastic) shape, which keeps the strict topology guard."""
+    import json
+
+    mf = os.path.join(str(ckpt), "manifest.json")
+    with open(mf) as fh:
+        manifest = json.load(fh)
+    manifest.pop("mesh", None)
+    with open(mf, "w") as fh:
+        json.dump(manifest, fh)
+
+
 def _tree(tmp_path, data, *, epochs, save_every=4, resume=None, load_capsules=True,
           project_root=None, seed=0, input_spec=None):
     model = rt.Module(
@@ -394,13 +407,16 @@ def test_sigterm_handler_single_install(tmp_path, devices):
 
 
 def test_topology_guard(tmp_path, devices):
-    """Resume refuses a different process count (reference
-    launcher.py:370-375). Single-process env: simulate by editing the saved
+    """Resume refuses a different process count for LEGACY (pre-elastic,
+    no manifest mesh section) snapshots (reference launcher.py:370-375).
+    Mesh-stamped snapshots relax this to a logged elastic resume — see
+    test_elastic.py.  Single-process env: simulate by editing the saved
     launcher state."""
     data = synthetic_classification(n=128)
     launcher, _ = _tree(tmp_path, data, epochs=1, save_every=2)
     launcher.launch()
     ckpt = tmp_path / "ckpt" / "v0" / "weights" / "000001"
+    _strip_mesh(ckpt)
 
     launcher2, _ = _tree(tmp_path, data, epochs=1, resume=str(ckpt))
     launcher2._saved_num_procs = None  # reset
@@ -558,14 +574,16 @@ def test_weights_only_resume_reseeds_ema(tmp_path, devices):
 def test_weights_only_topology_guard(tmp_path, devices):
     """The topology guard applies to BOTH resume paths (reference
     launcher.py:370-375): a weights-only restore of arrays saved by a
-    different process count is still an elastic resume.  Single-process
-    env: pretend the current run has 2 processes."""
+    different process count is still an elastic resume — and for a LEGACY
+    (no mesh section) snapshot it must stay fatal.  Single-process env:
+    pretend the current run has 2 processes."""
     from rocket_tpu.runtime import Runtime
 
     data = synthetic_classification(n=128)
     launcher, _ = _tree(tmp_path, data, epochs=1, save_every=2)
     launcher.launch()
     ckpt = str(tmp_path / "ckpt" / "v0" / "weights" / "000001")
+    _strip_mesh(ckpt)
 
     launcher2, _ = _tree(
         tmp_path, data, epochs=1, resume=ckpt, load_capsules=False
