@@ -41,6 +41,12 @@ request trace so the two disciplines are directly comparable:
   https://ui.perfetto.dev) is written with its path printed.  Combine
   with ``--stuck-round`` to see the watchdog-trip crash dump attached
   to the ``Failed`` results.
+- ``--metrics-port P`` — arm the goodput/retrace ledgers
+  (:mod:`rocket_tpu.observe.ledger`) and serve Prometheus text on
+  ``http://127.0.0.1:P/metrics`` (``0`` = OS-assigned; the live serve /
+  fleet counters register as export sources for the duration of the
+  run).  The goodput bucket table prints at exit.  Works with every
+  mode.
 
 Both modes use the int8 self-draft speculative decoder (per-row KV
 frontiers, no per-token host sync) and report per-request latency
@@ -280,6 +286,13 @@ def run_robust(args, model, draft, params, draft_params, arrivals, prompts):
                           if args.stuck_round >= 0 else None),
         clock=now, tracer=tracer, recorder=recorder,
     )
+    if args.metrics_port >= 0:
+        # /metrics exports the live loop counters + latency percentiles
+        # alongside the goodput/ledger gauges for the duration of the run
+        from rocket_tpu.observe.export import register_source
+
+        register_source("serve", loop.counters.snapshot)
+        register_source("serve_latency", loop.latency.summary)
     health = loop.health
     print(f"  [robust] health: {health.value}")
     submitted = 0
@@ -303,6 +316,11 @@ def run_robust(args, model, draft, params, draft_params, arrivals, prompts):
         results.extend(loop.drain_results())
     total = now()
     loop.close()
+    if args.metrics_port >= 0:
+        from rocket_tpu.observe.export import unregister_source
+
+        unregister_source("serve")
+        unregister_source("serve_latency")
 
     kinds = {Completed: "completed", Overloaded: "overloaded",
              DeadlineExceeded: "deadline", Failed: "failed"}
@@ -385,6 +403,11 @@ def run_fleet(args, model, draft, params, draft_params, arrivals, prompts):
                for i in range(args.prefill_replicas)]
     router = FleetRouter(replicas, prefill_replicas=prefill, clock=now)
     router.start()
+    if args.metrics_port >= 0:
+        from rocket_tpu.observe.export import register_source
+
+        register_source("fleet", router.snapshot)
+        register_source("fleet_latency", lambda: router.latency().summary())
     lanes = (f"{len(replicas)} decode + {len(prefill)} prefill replicas"
              if prefill else f"{len(replicas)} replicas (merged lane)")
     print(f"  [fleet] serving {R} requests across {lanes}")
@@ -447,6 +470,11 @@ def run_fleet(args, model, draft, params, draft_params, arrivals, prompts):
             print(f"  [fleet] {name:<8} p50 {p50:8.1f}  "
                   f"p95 {summary[f'{name}/p95']:8.1f}")
     router.close()
+    if args.metrics_port >= 0:
+        from rocket_tpu.observe.export import unregister_source
+
+        unregister_source("fleet")
+        unregister_source("fleet_latency")
 
     done = [r for r in results if isinstance(r, Completed)]
     lat = np.asarray([r.finished_at - arrivals[r.rid] for r in done])
@@ -510,6 +538,11 @@ def main():
                              "spans, a p50/p95 TTFT/TPOT table, and a "
                              "flight-recorder dump path at exit "
                              "(implies --mode robust)")
+    parser.add_argument("--metrics-port", type=int, default=-1,
+                        help="arm the goodput/retrace ledgers and serve "
+                             "Prometheus text on this port's /metrics "
+                             "(0 = OS-assigned; -1 = off); prints the "
+                             "goodput bucket table at exit")
     args = parser.parse_args()
     if args.trace and args.mode not in ("robust", "fleet"):
         print("--trace instruments the robust loop; switching to "
@@ -534,14 +567,38 @@ def main():
     prompts = rng.integers(0, VOCAB, size=(args.requests, PROMPT))
     model, draft, params, draft_params = _build()
 
+    metrics = None
+    if args.metrics_port >= 0:
+        from rocket_tpu.observe.export import MetricsServer
+        from rocket_tpu.observe.ledger import arm_ledgers
+
+        # arm both ledgers: compiles land in the goodput "compile"
+        # bucket and every named jit edge runs under the retrace sentinel
+        arm_ledgers()
+        metrics = MetricsServer(port=args.metrics_port).start()
+        print(f"[metrics] scrape http://127.0.0.1:{metrics.port}/metrics "
+              f"(JSON: /metrics.json) while the demo runs")
+
     runners = {"group": run_group, "continuous": run_continuous,
                "robust": run_robust, "fleet": run_fleet}
     modes = ["group", "continuous"] if args.mode == "both" else [args.mode]
     results = {}
-    for m in modes:
-        results[m] = runners[m](args, model, draft, params, draft_params,
-                                arrivals, prompts)
-        _report(m, results[m], args.requests)
+    try:
+        for m in modes:
+            results[m] = runners[m](args, model, draft, params,
+                                    draft_params, arrivals, prompts)
+            _report(m, results[m], args.requests)
+    finally:
+        if metrics is not None:
+            from rocket_tpu.observe.ledger import (
+                disarm_ledgers,
+                get_goodput,
+            )
+
+            disarm_ledgers()
+            for line in get_goodput().table().splitlines():
+                print(f"[metrics] {line}")
+            metrics.stop()
     if len(results) == 2:
         g = np.percentile(results["group"]["lat"], 50)
         c = np.percentile(results["continuous"]["lat"], 50)

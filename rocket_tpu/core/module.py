@@ -51,6 +51,7 @@ from rocket_tpu.engine.step import (
     build_train_step,
     build_window_step,
 )
+from rocket_tpu.observe.trace import span as trace_span
 from rocket_tpu.parallel.sharding import tree_shardings
 
 
@@ -421,6 +422,16 @@ class Module(Dispatcher):
         return self._fuse_accum and self._accum > 1
 
     def _build_steps(self, policy) -> None:
+        # The jit edges built here are the ledger's training chokepoints:
+        # every step variant comes back as an ``_AnnotatedStep`` whose
+        # dispatch routes through ``observe.ledger.ledger_call``, so a
+        # post-warmup retrace of any of them trips the runtime sentinel.
+        # The span times only host-side jit construction (compilation
+        # happens at first dispatch, where the ledger attributes it).
+        with trace_span("module/build_steps", fused=self._use_window):
+            self._build_steps_inner(policy)
+
+    def _build_steps_inner(self, policy) -> None:
         skip = (
             self._skip_nonfinite
             if self._skip_nonfinite is not None

@@ -34,12 +34,14 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Any, Callable, Iterator, Optional
 
 import jax
 import numpy as np
 
 from rocket_tpu.core.attributes import Attributes
+from rocket_tpu.observe.ledger import get_goodput
 from rocket_tpu.utils.placement import collate as default_collate
 from rocket_tpu.utils.retry import retry_call
 
@@ -581,9 +583,21 @@ class DataLoader:
                         continue
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()
+        goodput = get_goodput()
         try:
             while True:
-                item = q.get()
+                if goodput.armed and q.empty():
+                    # Prefetch ring empty: the consumer is about to block
+                    # on the producer — that wait is data-starved time
+                    # (nested: it happens inside the looper's dispatch
+                    # gap, which subtracts it before charging
+                    # host_blocked).
+                    t0 = time.perf_counter()
+                    item = q.get()
+                    goodput.add("data_starved", time.perf_counter() - t0,
+                                nested=True)
+                else:
+                    item = q.get()
                 if item is sentinel:
                     if error:
                         raise error[0]

@@ -45,6 +45,7 @@ import optax
 from rocket_tpu.engine.ema import find_params_ema
 from rocket_tpu.engine.precision import Policy
 from rocket_tpu.engine.state import TrainState
+from rocket_tpu.observe.ledger import ledger_call
 from rocket_tpu.observe.profile import annotate
 
 # ``apply_fn(params, mutable, rng, batch, train)`` -> ``(batch_out, mutable)``
@@ -77,7 +78,12 @@ class _AnnotatedStep:
     (``looper/host_fetch``).  Calls forward positionally, so donated
     buffers donate exactly as before, and every other ``PjitFunction``
     attribute (``lower``, ``_cache_size``, ...) delegates to the wrapped
-    function, which stays reachable as ``.jitted``."""
+    function, which stays reachable as ``.jitted``.
+
+    Dispatch routes through :func:`~rocket_tpu.observe.ledger.ledger_call`
+    (ISSUE 9): when the retrace ledger is armed, every compile at this
+    edge is recorded and an unexpected post-warmup retrace escalates to a
+    flight-recorder dump; disarmed, the wrapper is one attribute check."""
 
     __slots__ = ("jitted", "_name")
 
@@ -87,7 +93,7 @@ class _AnnotatedStep:
 
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
         with annotate(self._name):
-            return self.jitted(*args, **kwargs)
+            return ledger_call(self.jitted, self._name, *args, **kwargs)
 
     def __getattr__(self, attr: str) -> Any:
         return getattr(self.jitted, attr)

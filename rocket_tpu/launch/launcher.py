@@ -52,6 +52,15 @@ class Launcher(Dispatcher):
         Runtime policy knobs (reference ``launcher.py:100-101``).
     project_root:
         Parent of experiment dirs (default ``./experiments``).
+    goodput:
+        Arm the goodput + retrace ledgers for the run (default True —
+        the disarmed-equivalent cost is one branch per dispatch, and the
+        armed overhead is bounded by the bench guard).  The bucket table
+        is logged at launch end and persisted as ``<project>/goodput.json``.
+    metrics_port:
+        Opt-in: serve the Prometheus-text ``/metrics`` endpoint on this
+        port for the duration of the run (``0`` = OS-assigned; ``None``
+        = no endpoint).
     """
 
     def __init__(
@@ -69,6 +78,8 @@ class Launcher(Dispatcher):
         statefull: bool = True,
         priority: int = 1000,
         logger: Optional[Any] = None,
+        goodput: bool = True,
+        metrics_port: Optional[int] = None,
     ) -> None:
         super().__init__(
             capsules=capsules, statefull=statefull, priority=priority, logger=logger
@@ -85,6 +96,9 @@ class Launcher(Dispatcher):
         self._epoch_idx = 0
         self._resume_path: Optional[str] = None
         self._resume_load_capsules = True
+        self._goodput = bool(goodput)
+        self._metrics_port = metrics_port
+        self._metrics_server: Optional[Any] = None
 
     # -- project dirs --------------------------------------------------------
 
@@ -138,6 +152,8 @@ class Launcher(Dispatcher):
         self._create_project_dir(runtime)
         if getattr(runtime, "tracing", False):
             self._arm_flight_recorder(runtime)
+        if self._goodput:
+            self._arm_goodput()
         if self._resume_path is not None:
             resolved = self._resolve_resume_path(runtime)
             if resolved is not None:
@@ -170,6 +186,29 @@ class Launcher(Dispatcher):
         self._logger.info(
             "tracing armed: flight recorder -> %s", rec.out_dir
         )
+
+    def _arm_goodput(self) -> None:
+        """Open the run's goodput window and arm the retrace sentinel
+        (ISSUE 9).  Safe without tracing: the sentinel only dumps when a
+        flight recorder is installed, and the goodput buckets are plain
+        host arithmetic.  The goodput snapshot also rides along in every
+        flight dump via the recorder's dump-writer hook.  Lazy imports,
+        same discipline as ``_arm_flight_recorder``."""
+        from rocket_tpu.observe import ledger as ledger_mod
+        from rocket_tpu.observe import recorder as flightrec
+
+        ledger_mod.arm_ledgers()
+        flightrec.add_dump_writer(ledger_mod.goodput_dump_writer)
+        if self._metrics_port is not None:
+            from rocket_tpu.observe.export import MetricsServer
+
+            self._metrics_server = MetricsServer(
+                port=int(self._metrics_port)
+            ).start()
+            self._logger.info(
+                "metrics endpoint: http://127.0.0.1:%d/metrics",
+                self._metrics_server.port,
+            )
 
     def _resolve_resume_path(self, runtime: Runtime) -> Optional[str]:
         """Turn the armed resume request into a VERIFIED snapshot path.
@@ -241,10 +280,18 @@ class Launcher(Dispatcher):
         spec = getattr(self._runtime, "resume_spec", None)
         if spec is None:
             return  # resume('auto') with nothing on disk — fresh start
+        from rocket_tpu.observe.ledger import get_goodput
         from rocket_tpu.persist import integrity
         from rocket_tpu.persist.orbax_io import default_io
 
-        io = default_io()
+        # Restore time is checkpoint-bucket time; a restart that replays
+        # steps additionally reports into preemption_loss via
+        # GoodputLedger.note_preemption_loss (the replay estimate lives
+        # with whoever knows the step cadence, not here).
+        with get_goodput().timed("checkpoint"):
+            self._resume_inner(spec, integrity, default_io())
+
+    def _resume_inner(self, spec: Any, integrity: Any, io: Any) -> None:
         # The VERIFIED path from _resolve_resume_path — not the raw request
         # ('auto', or a corrupt dir that fell back to a sibling).
         path = str(spec.path)
@@ -433,7 +480,41 @@ class Launcher(Dispatcher):
             raise
         finally:
             del attrs.launcher
+            self._finish_goodput()
             self.destroy(attrs)
+
+    def _finish_goodput(self) -> None:
+        """Close the goodput window, persist ``<project>/goodput.json``
+        (main process), log the bucket table, stop the metrics endpoint.
+        Never raises — run teardown must proceed regardless."""
+        if not self._goodput:
+            return
+        try:
+            from rocket_tpu.observe.ledger import disarm_ledgers, get_goodput
+
+            goodput = get_goodput()
+            disarm_ledgers()  # freezes the window; snapshot stays valid
+            runtime = self._runtime
+            if (
+                runtime is not None
+                and runtime.project_dir is not None
+                and runtime.is_main_process
+            ):
+                path = os.path.join(runtime.project_dir, "goodput.json")
+                goodput.save(path)
+                self._logger.info("goodput ledger -> %s", path)
+            for line in goodput.table().splitlines():
+                self._logger.info("%s", line)
+        except Exception:
+            self._logger.warning("goodput finalization failed",
+                                 exc_info=True)
+        finally:
+            if self._metrics_server is not None:
+                try:
+                    self._metrics_server.stop()
+                except Exception:
+                    pass
+                self._metrics_server = None
 
     def _dump_flight_recorder(self, reason: str) -> None:
         from rocket_tpu.observe.recorder import active_recorder

@@ -52,6 +52,11 @@ from typing import Any, Iterable, Optional
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
 from rocket_tpu.core.dispatcher import Dispatcher
+from rocket_tpu.observe.ledger import (
+    emit_gauges,
+    get_goodput,
+    memory_watermarks,
+)
 
 try:
     from termcolor import colored
@@ -300,11 +305,21 @@ class Looper(Dispatcher):
 
             tracer = _tracer()
         window = self._lag_window
+        # Goodput accounting, hoisted like ``traced``: per iteration the
+        # armed path adds one clock read, one nested-seconds diff, and two
+        # bucket adds — bounded by the same <5% guard as tracing.
+        goodput = get_goodput()
+        gp_armed = goodput.armed
+        gp_wall = 0.0
+        gp_iters = 0
+        nested0 = 0.0
         try:
             # repeats=None: unbounded streaming cycle, ended by the child
             # Dataset's termination vote when the stream exhausts.
             while looper.repeats is None or self._iter_idx < looper.repeats:
                 gap_t0 = time.perf_counter()
+                if gp_armed:
+                    nested0 = goodput.nested_seconds()
                 attrs.batch = None
                 # Cleared WITH the batch: an iteration where no step runs
                 # (dataset exhausted on a resumed epoch) must not re-expose
@@ -325,7 +340,8 @@ class Looper(Dispatcher):
                 # Host dispatch gap: everything above ran without waiting
                 # on the device (in async mode); the backpressure wait
                 # below is device time and deliberately NOT counted.
-                self._gap_sum += time.perf_counter() - gap_t0
+                gap = time.perf_counter() - gap_t0
+                self._gap_sum += gap
                 self._gap_count += 1
                 if window is not None:
                     looper.lagged_logs = None
@@ -337,6 +353,19 @@ class Looper(Dispatcher):
                             # host is > k steps ahead of the device.
                             looper.lagged_logs = popped
                             self._lagged_state = popped
+                if gp_armed:
+                    # Bucket split for this iteration: the dispatch gap is
+                    # host-side (minus whatever nested buckets — compile,
+                    # data-starved, checkpoint — already claimed inside
+                    # it); the remainder to here is the backpressure wait,
+                    # i.e. the device productively stepping.
+                    cycle_wall = time.perf_counter() - gap_t0
+                    nested_delta = goodput.nested_seconds() - nested0
+                    goodput.add("productive", max(0.0, cycle_wall - gap))
+                    goodput.add("host_blocked",
+                                max(0.0, gap - nested_delta))
+                    gp_wall += cycle_wall
+                    gp_iters += 1
                 self._iter_idx += 1
                 if looper.terminate or (
                     self._runtime is not None and self._runtime.stop_training
@@ -358,6 +387,12 @@ class Looper(Dispatcher):
             if bar is not None:
                 bar.set_postfix(self._format_state(looper.state))
                 bar.close()
+            if gp_armed and gp_iters:
+                # Cycle-boundary telemetry (already a sync point): device
+                # memory watermarks and — when a step-cost hint is
+                # installed — live MFU/MBU over the mean iteration wall.
+                memory_watermarks()
+                emit_gauges(gp_wall / gp_iters)
         attrs.batch = None
         attrs.step_logs = None
 

@@ -30,6 +30,21 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from rocket_tpu.observe.ledger import get_retrace_ledger, ledger_call
+
+# The batcher's prefill/admit/import edges retrace BY DESIGN — every new
+# prompt length is a new signature (the one-dispatch batched paths pad to
+# fixed shapes; the round-granular step API deliberately does not pad the
+# prefill).  Register them as ledger-exempt so the retrace sentinel never
+# fires on legitimate per-prompt compiles; ``generate/spec_round`` is NOT
+# exempt — its shapes are fixed after warmup, and an unexpected round
+# retrace is exactly the bug the sentinel exists to catch (the serve
+# loop's deliberate inline n_draft compiles run under ``expect_compile``).
+get_retrace_ledger().exempt(
+    "generate/spec_prefill", "generate/spec_admit",
+    "generate/spec_import_row",
+)
+
 
 def _truncate_logits(logits: jax.Array, top_k: Optional[int],
                      top_p: Optional[float]) -> jax.Array:
@@ -1188,7 +1203,8 @@ class ContinuousBatcher:
                 f"({self.total_len}); the buffer needs room for at least "
                 f"one generated token"
             )
-        self.state = _spec_prefill(
+        self.state = ledger_call(
+            _spec_prefill, "generate/spec_prefill",
             self._model, self._draft_model, self._params,
             self._draft_params, prompts, self._rng, self._temperature,
             max_new_tokens=self.total_len - P, **self._kw(),
@@ -1199,7 +1215,8 @@ class ContinuousBatcher:
         ``(n_tok [B], done [B])`` as host numpy arrays."""
         if self.state is None:
             raise ValueError("call start() before step()")
-        self.state = _spec_round(
+        self.state = ledger_call(
+            _spec_round, "generate/spec_round",
             self._model, self._draft_model, self._params,
             self._draft_params, self.state, self._temperature,
             n_draft=self.n_draft, **self._kw(),
@@ -1243,7 +1260,8 @@ class ContinuousBatcher:
             )
         self._admits += 1
         key = jax.random.fold_in(self._rng, self._admits)
-        self.state = _spec_admit(
+        self.state = ledger_call(
+            _spec_admit, "generate/spec_admit",
             self._model, self._draft_model, self._params,
             self._draft_params, self.state, jnp.int32(row), prompt_row,
             key, self._temperature, **self._kw(),
@@ -1283,7 +1301,8 @@ class ContinuousBatcher:
         if key is None:
             self._admits += 1
             key = jax.random.fold_in(self._rng, self._admits)
-        state1 = _spec_prefill(
+        state1 = ledger_call(
+            _spec_prefill, "generate/spec_prefill",
             self._model, self._draft_model, self._params,
             self._draft_params, prompt_row, key, self._temperature,
             max_new_tokens=self.total_len - P, **self._kw(),
@@ -1316,7 +1335,8 @@ class ContinuousBatcher:
                 f"batcher's total_len ({self.total_len}); prefill and "
                 f"decode lanes must share the buffer layout"
             )
-        self.state = _spec_import_row(
+        self.state = ledger_call(
+            _spec_import_row, "generate/spec_import_row",
             self.state, jnp.int32(row), handoff.buf, handoff.n_tok,
             handoff.done, handoff.cache_t, handoff.cache_d,
         )

@@ -1,0 +1,313 @@
+"""Metrics export — one snapshot surface over every counter in the repo.
+
+Everything observable already lives in flat ``Dict[str, float]`` form:
+``ServeCounters.snapshot()``, ``FleetCounters.snapshot()``,
+``ServeLatency.summary()``, the goodput/retrace ledgers, and the
+``device/*`` gauges.  This module is the thin export layer on top:
+
+- a **source registry** (:func:`register_source`) any subsystem can hang
+  its snapshot callable on — :func:`collect` merges all of them, always
+  including the goodput and retrace ledgers and the device memory
+  watermarks;
+- a stdlib-only **Prometheus text** formatter (:func:`prometheus_text`)
+  and an opt-in ``/metrics`` HTTP endpoint (:class:`MetricsServer`, port
+  chosen by the caller; ``port=0`` lets the OS pick — tests use that);
+- a **snapshot CLI** (``python -m rocket_tpu.observe.export``) that
+  merges per-replica / per-host snapshot JSON files into one fleet view;
+- a cross-host gather (:func:`gather_counters`) built on
+  ``parallel/multihost.process_allgather`` with the same padded-uint8
+  object transport as ``broadcast_object``.
+
+Merge semantics (:func:`merge_counters`): plain counters SUM across
+sources; percentile keys (``.../p50|p95|p99``) take the MAX — summing
+percentiles is meaningless, and the conservative fleet-wide answer to
+"what is my p99" from per-replica p99s is the worst replica.  This is
+documented, not hidden: exact fleet percentiles require merging the
+histograms themselves (``ServeLatency.merge``), which the router already
+does live.
+
+No third-party dependency anywhere — ``http.server`` + ``json`` only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional
+
+from rocket_tpu.observe.ledger import (
+    get_goodput,
+    get_retrace_ledger,
+    memory_watermarks,
+)
+
+# -- source registry ---------------------------------------------------------
+
+_SOURCES: Dict[str, Callable[[], Dict[str, float]]] = {}
+_SOURCES_LOCK = threading.Lock()
+
+
+def register_source(name: str,
+                    snapshot_fn: Callable[[], Dict[str, float]]) -> None:
+    """Register a flat-float-dict snapshot callable under ``name``; its
+    keys are exported prefixed ``<name>/``.  Re-registering replaces."""
+    with _SOURCES_LOCK:
+        _SOURCES[name] = snapshot_fn
+
+
+def unregister_source(name: str) -> None:
+    with _SOURCES_LOCK:
+        _SOURCES.pop(name, None)
+
+
+def collect() -> Dict[str, float]:
+    """One merged snapshot of everything: goodput buckets, retrace-ledger
+    counters, device memory watermarks, and every registered source.  A
+    failing source is skipped (an export must never take the run down)."""
+    out: Dict[str, float] = {}
+    for key, value in get_goodput().snapshot().items():
+        out[f"goodput/{key}"] = float(value)
+    for key, value in get_retrace_ledger().snapshot().items():
+        out[f"ledger/{key}"] = float(value)
+    try:
+        out.update(memory_watermarks(tracer=None))
+    except Exception:
+        pass
+    with _SOURCES_LOCK:
+        sources = list(_SOURCES.items())
+    for name, fn in sources:
+        try:
+            snap = fn()
+        except Exception:
+            continue
+        for key, value in snap.items():
+            try:
+                out[f"{name}/{key}"] = float(value)
+            except (TypeError, ValueError):
+                continue
+    return out
+
+
+# -- merge across replicas / hosts -------------------------------------------
+
+_PERCENTILE_KEY = re.compile(r"/p\d+$")
+
+
+def merge_counters(snapshots: List[Dict[str, float]]) -> Dict[str, float]:
+    """Fold per-replica/per-host flat snapshots into one: counters sum,
+    percentile keys take the max (worst replica — see module docstring)."""
+    out: Dict[str, float] = {}
+    for snap in snapshots:
+        for key, value in snap.items():
+            try:
+                value = float(value)
+            except (TypeError, ValueError):
+                continue
+            if key in out and _PERCENTILE_KEY.search(key):
+                out[key] = max(out[key], value)
+            else:
+                out[key] = out.get(key, 0.0) + value
+    return out
+
+
+def gather_counters(
+    local: Dict[str, float]
+) -> List[Dict[str, float]]:
+    """All-gather each host's snapshot dict onto every host.  Single
+    process (every test, most demos) is an identity; multi-host encodes
+    JSON as a max-length-padded uint8 buffer over ``process_allgather``
+    — the same transport discipline as ``multihost.broadcast_object``."""
+    try:
+        from rocket_tpu.parallel import multihost
+
+        nproc = multihost.process_count()
+    except Exception:
+        return [dict(local)]
+    if nproc <= 1:
+        return [dict(local)]
+    import numpy as np
+
+    payload = json.dumps(local, sort_keys=True).encode()
+    lengths = multihost.process_allgather(
+        np.asarray(len(payload), dtype=np.int64)
+    )
+    lengths = np.asarray(lengths).reshape(-1)
+    max_len = int(lengths.max())
+    buf = np.zeros(max_len, dtype=np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    gathered = np.asarray(multihost.process_allgather(buf)).reshape(
+        nproc, max_len
+    )
+    out: List[Dict[str, float]] = []
+    for row, length in zip(gathered, lengths):
+        try:
+            out.append(json.loads(row[: int(length)].tobytes().decode()))
+        except (ValueError, UnicodeDecodeError):
+            out.append({})
+    return out
+
+
+# -- Prometheus text exposition ----------------------------------------------
+
+_METRIC_CHARS = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _metric_name(key: str) -> str:
+    name = _METRIC_CHARS.sub("_", key.strip()).strip("_").lower()
+    if not name:
+        name = "unnamed"
+    if name[0].isdigit():
+        name = "_" + name
+    return f"rocket_tpu_{name}"
+
+
+def prometheus_text(metrics: Optional[Dict[str, float]] = None) -> str:
+    """Render a flat snapshot in the Prometheus text exposition format
+    (version 0.0.4): ``# HELP`` / ``# TYPE gauge`` / sample per metric.
+    Everything is exported as a gauge — the scraper sees point-in-time
+    snapshots of monotone counters and instantaneous gauges alike."""
+    if metrics is None:
+        metrics = collect()
+    lines: List[str] = []
+    for key in sorted(metrics):
+        try:
+            value = float(metrics[key])
+        except (TypeError, ValueError):
+            continue
+        name = _metric_name(key)
+        lines.append(f"# HELP {name} rocket_tpu metric {key}")
+        lines.append(f"# TYPE {name} gauge")
+        if value != value:  # NaN
+            lines.append(f"{name} NaN")
+        else:
+            lines.append(f"{name} {value!r}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+# -- /metrics endpoint -------------------------------------------------------
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self) -> None:  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0]
+        if path in ("/metrics", "/"):
+            body = prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/metrics.json":
+            body = json.dumps(collect(), sort_keys=True).encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404)
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        pass  # scrapes poll; stdout noise helps nobody
+
+
+class MetricsServer:
+    """Opt-in ``/metrics`` endpoint on a daemon thread.
+
+    ``port=0`` binds an ephemeral port (read it back from ``.port`` after
+    :meth:`start`) — what tests and same-host scrape configs use.  The
+    server thread is a daemon: an exiting run never hangs on the scraper.
+    """
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1") -> None:
+        self._host = host
+        self._requested_port = int(port)
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def port(self) -> int:
+        if self._httpd is not None:
+            return self._httpd.server_address[1]
+        return self._requested_port
+
+    @property
+    def running(self) -> bool:
+        return self._httpd is not None
+
+    def start(self) -> "MetricsServer":
+        if self._httpd is not None:
+            return self
+        self._httpd = ThreadingHTTPServer(
+            (self._host, self._requested_port), _MetricsHandler
+        )
+        self._httpd.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="rocket-metrics",
+            daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        self._httpd = None
+        self._thread = None
+
+
+# -- snapshot CLI ------------------------------------------------------------
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rocket_tpu.observe.export",
+        description="Merge per-replica/per-host flat metric snapshots "
+        "(JSON files of name->float) into one fleet snapshot; with no "
+        "files, export this process's live collect().",
+    )
+    parser.add_argument(
+        "snapshots", nargs="*",
+        help="snapshot JSON files (e.g. each replica's counters dump)",
+    )
+    parser.add_argument(
+        "--format", choices=("prom", "json"), default="prom",
+        help="output format: Prometheus text (default) or JSON",
+    )
+    parser.add_argument(
+        "-o", "--out", default=None,
+        help="write to this path instead of stdout",
+    )
+    args = parser.parse_args(argv)
+    if args.snapshots:
+        snaps: List[Dict[str, float]] = []
+        for path in args.snapshots:
+            with open(path) as f:
+                doc = json.load(f)
+            if not isinstance(doc, dict):
+                parser.error(f"{path}: expected a flat JSON object")
+            snaps.append(doc)
+        merged = merge_counters(snaps)
+    else:
+        merged = merge_counters(gather_counters(collect()))
+    if args.format == "json":
+        text = json.dumps(merged, indent=2, sort_keys=True) + "\n"
+    else:
+        text = prometheus_text(merged)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        print(f"wrote {len(merged)} metric(s) -> {args.out}")
+    else:
+        print(text, end="")
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    raise SystemExit(_main())

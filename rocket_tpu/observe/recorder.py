@@ -25,10 +25,11 @@ import json
 import logging
 import os
 import re
+import shutil
 import signal
 import threading
 import time
-from typing import Any, Optional
+from typing import Any, Callable, List, Optional
 
 from rocket_tpu.observe.trace import Tracer, _process_index, get_tracer
 
@@ -56,6 +57,7 @@ class FlightRecorder:
         out_dir: str = "flightrec",
         tail: int = 48,
         logger: Optional[logging.Logger] = None,
+        keep_last: int = 16,
     ) -> None:
         self._tracer = tracer if tracer is not None else get_tracer()
         self.out_dir = out_dir
@@ -63,6 +65,10 @@ class FlightRecorder:
         self._log = logger if logger is not None else LOG
         self._lock = threading.Lock()
         self._seq = 0
+        # Retention: watchdog trips and chaos tests dump repeatedly into
+        # one out_dir; keep the newest N dump dirs, prune the rest
+        # (0 = unbounded).
+        self.keep_last = int(keep_last)
         self.last_dump: Optional[str] = None
 
     @property
@@ -88,9 +94,56 @@ class FlightRecorder:
             with open(os.path.join(path, "tail.txt"), "w") as f:
                 f.write(f"flight recorder dump — reason: {reason}\n")
                 f.write(self._tracer.tail_text(self._tail))
+            for writer in list(_DUMP_WRITERS):
+                try:
+                    writer(path)
+                except Exception:
+                    pass  # an extra artifact must never fail the dump
+            self._prune_old()
             self.last_dump = path
             self._log.warning("flight recorder dump (%s) -> %s", reason, path)
             return path
+
+    # Dump names start with a %Y%m%d-%H%M%S stamp then a zero-padded seq,
+    # so lexicographic order IS creation order.
+    _DUMP_DIR = re.compile(r"^\d{8}-\d{6}-\d{3}-")
+
+    def _prune_old(self) -> None:
+        """Keep the newest ``keep_last`` dump dirs under ``out_dir``."""
+        if self.keep_last <= 0:
+            return
+        try:
+            entries = sorted(
+                e for e in os.listdir(self.out_dir)
+                if self._DUMP_DIR.match(e)
+                and os.path.isdir(os.path.join(self.out_dir, e))
+            )
+        except OSError:
+            return
+        for stale in entries[: max(0, len(entries) - self.keep_last)]:
+            shutil.rmtree(os.path.join(self.out_dir, stale),
+                          ignore_errors=True)
+
+
+# -- extra dump artifacts ----------------------------------------------------
+
+# Callables invoked with each dump directory after trace.json/tail.txt are
+# written — how the goodput ledger rides along in every flight dump without
+# the recorder importing it.  Each is exception-isolated at call time.
+_DUMP_WRITERS: List[Callable[[str], None]] = []
+
+
+def add_dump_writer(writer: Callable[[str], None]) -> None:
+    """Register an extra per-dump artifact writer (idempotent)."""
+    if writer not in _DUMP_WRITERS:
+        _DUMP_WRITERS.append(writer)
+
+
+def remove_dump_writer(writer: Callable[[str], None]) -> None:
+    try:
+        _DUMP_WRITERS.remove(writer)
+    except ValueError:
+        pass
 
 
 # -- process-global recorder + SIGTERM chaining ------------------------------

@@ -56,7 +56,7 @@ def _note_fallback(reason: str, M: int, K: int, N: int,
     """
     from rocket_tpu.observe.trace import counter
 
-    counter("quant.int8_matmul.fallback", 1, reason=reason, M=M, K=K, N=N)
+    counter("quant/int8_matmul/fallback", 1, reason=reason, M=M, K=K, N=N)
     global _warned_fallback
     if _warned_fallback or not remediable:
         return

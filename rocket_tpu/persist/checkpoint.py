@@ -29,6 +29,7 @@ from typing import Any, Optional
 
 from rocket_tpu.core.attributes import Attributes
 from rocket_tpu.core.capsule import Capsule
+from rocket_tpu.observe.ledger import get_goodput
 from rocket_tpu.persist import emergency, integrity
 from rocket_tpu.persist.orbax_io import default_io
 
@@ -258,7 +259,8 @@ class Checkpointer(Capsule):
                 "SIGTERM received — writing preemption checkpoint"
             )
             self.save()
-            default_io().wait()
+            with get_goodput().timed("checkpoint"):
+                default_io().wait()  # durable before the grace window ends
             if self._etier is not None:
                 # The durable grace-window snapshot above supersedes any
                 # staged (strictly older) emergency capture.
@@ -339,6 +341,13 @@ class Checkpointer(Capsule):
     def save(self, path: Optional[str] = None) -> str:
         """Snapshot every registered capsule's state (reference
         ``checkpoint.py:83-132``); async, multi-host coordinated."""
+        # Goodput: the host-side cost of ISSUING the save (collect +
+        # manifest + the previous save's drain inside _prune) — the async
+        # write itself overlaps compute and is deliberately not charged.
+        with get_goodput().timed("checkpoint"):
+            return self._save_inner(path)
+
+    def _save_inner(self, path: Optional[str] = None) -> str:
         track = path is None
         if path is None:
             path = os.path.join(

@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from rocket_tpu.observe.ledger import expect_compile, get_goodput
 from rocket_tpu.observe.recorder import active_recorder
 from rocket_tpu.observe.trace import get_tracer
 from rocket_tpu.serve.metrics import ServeCounters, ServeLatency
@@ -201,7 +202,8 @@ class ServingLoop:
         bat.start(warm)
         for r in range(self._max_batch):
             bat.retire(r)
-        bat.step()  # inline: compile, not serve
+        with expect_compile("generate/spec_round"):
+            bat.step()  # inline: compile, not serve
         self._compiled_drafts = {int(bat.n_draft)}
         self._carry = (np.asarray(bat.state[0]), np.asarray(bat.state[1]))
 
@@ -480,8 +482,11 @@ class ServingLoop:
             with round_span:
                 if n_draft not in self._compiled_drafts:
                     # first build of this variant: compile inline, unwatched
+                    # — and DELIBERATE, so the retrace sentinel must not
+                    # treat the new n_draft signature as a shape bug
                     round_span.add(compile=True)
-                    ok, value = True, _step()
+                    with expect_compile("generate/spec_round"):
+                        ok, value = True, _step()
                     self._compiled_drafts.add(n_draft)
                 else:
                     ok, value = self.watchdog.run(_step)
@@ -570,9 +575,10 @@ class ServingLoop:
         fresh one.  The persistent ``_spec_round`` jit cache keys on
         structurally-hashed modules, so this does NOT retrace; the cost
         is one dummy prefill + round."""
-        self._bat = self._build_batcher()
-        self._bat.n_draft = self.policy.n_draft(self.base_n_draft)
-        self._warm_start(self._bat)
+        with get_goodput().timed("watchdog_rebuild"):
+            self._bat = self._build_batcher()
+            self._bat.n_draft = self.policy.n_draft(self.base_n_draft)
+            self._warm_start(self._bat)
         self._recover_in = self._recover_rounds
 
     def _harvest(self, now: float) -> None:

@@ -158,7 +158,7 @@ def successive_halving(
             if rec.get("value") and "suspect" not in rec:
                 measured.append(cand)
             else:
-                tracer.counter("tune.probe.dead", 1, rung=rung)
+                tracer.counter("tune/probe/dead", 1, rung=rung)
                 log(f"tune: rung {rung} dropped point "
                     f"({rec.get('error') or rec.get('suspect')})")
         if not measured:

@@ -56,6 +56,12 @@ def pytest_configure(config):
         "(mesh-stamped manifests, reshard-on-restore, emergency tier; "
         "see docs/reliability.md)",
     )
+    config.addinivalue_line(
+        "markers",
+        "goodput: goodput-ledger / retrace-sentinel / metrics-export tests "
+        "(rocket_tpu.observe.ledger|export; see docs/observability.md "
+        "\"Goodput & metrics export\")",
+    )
 
 
 @pytest.fixture(scope="session")

@@ -394,7 +394,7 @@ def test_int8_matmul_fallback_warns_once_and_counts(devices):
         assert len(msgs) == 1, msgs
         assert "multiple of 128" in msgs[0]  # the remedy
         events = [e for e in tracer.events()
-                  if e[1] == "quant.int8_matmul.fallback"]
+                  if e[1] == "quant/int8_matmul/fallback"]
         assert len(events) >= 2
         assert events[0][5]["reason"].startswith("K % 128")
 
@@ -403,13 +403,13 @@ def test_int8_matmul_fallback_warns_once_and_counts(devices):
         q2, s2 = quantize_int8(w2, axis=0)
         quant_mod._warned_fallback = False
         before = len([e for e in tracer.events()
-                      if e[1] == "quant.int8_matmul.fallback"])
+                      if e[1] == "quant/int8_matmul/fallback"])
         with warnings.catch_warnings(record=True) as caught2:
             warnings.simplefilter("always")
             int8_matmul(jnp.ones((200, 128), jnp.bfloat16), q2, s2)
         assert not [c for c in caught2 if "int8_matmul" in str(c.message)]
         after = [e for e in tracer.events()
-                 if e[1] == "quant.int8_matmul.fallback"]
+                 if e[1] == "quant/int8_matmul/fallback"]
         assert len(after) == before + 1
         assert "KERNEL_MAX_ROWS" in after[-1][5]["reason"]
     finally:

@@ -1,0 +1,106 @@
+"""Trace-name lint (ISSUE 9 satellite): every literal trace event name
+in the library follows the lowercase ``cat/name`` slash convention.
+
+The merged cross-host timeline, the flight-recorder tail, the Prometheus
+export, and the goodput/ledger counters all key off these names; a
+dot-separated or CamelCase stray silently forks the namespace (this lint
+caught ``quant.int8_matmul.fallback`` and ``tune.probe.dead``, renamed to
+slash form when it landed).  The scan is AST-based so multi-line calls
+are seen and docstring examples are not.
+"""
+
+import ast
+import os
+import re
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "rocket_tpu")
+
+# The emitting calls whose first positional argument is an event name.
+_EMITTERS = {"span", "counter", "instant", "health"}
+
+# lowercase slug segments joined by '/' — at least one slash (a bare
+# word has no category and collides with everything).  Dots are allowed
+# INSIDE a segment (e.g. a dotted metric suffix), never as the separator.
+_NAME_RE = re.compile(r"^[a-z0-9_]+(/[a-z0-9_.]+)+$")
+
+
+def _called_name(func):
+    """The trailing identifier of the call target: ``span`` for both the
+    module-level convenience and ``tracer.span``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _literal_name(node):
+    """First-arg string literal, with f-string ``{...}`` holes filled by
+    a placeholder segment (``f"{prefix}/depth"`` lints as ``x/depth``)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for piece in node.values:
+            if isinstance(piece, ast.Constant):
+                parts.append(str(piece.value))
+            else:
+                parts.append("x")
+        return "".join(parts)
+    return None
+
+
+def _scan_file(path):
+    with open(path) as f:
+        src = f.read()
+    try:
+        tree = ast.parse(src, filename=path)
+    except SyntaxError:  # pragma: no cover - the suite would be broken
+        return []
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        if _called_name(node.func) not in _EMITTERS:
+            continue
+        name = _literal_name(node.args[0])
+        if name is None:
+            continue  # computed names are the caller's responsibility
+        out.append((path, node.lineno, name))
+    return out
+
+
+def _all_sites():
+    sites = []
+    for dirpath, _dirnames, filenames in os.walk(PKG):
+        for fname in filenames:
+            if fname.endswith(".py"):
+                sites.extend(_scan_file(os.path.join(dirpath, fname)))
+    return sites
+
+
+@pytest.mark.goodput
+def test_library_emits_trace_events():
+    # the lint is only meaningful if the scan actually sees the emitters
+    names = {name for _p, _l, name in _all_sites()}
+    assert {"serve/submit", "ledger/compile",
+            "quant/int8_matmul/fallback"} <= names
+
+
+@pytest.mark.goodput
+def test_trace_names_follow_slash_convention():
+    bad = [
+        f"{os.path.relpath(path, REPO)}:{line}: {name!r}"
+        for path, line, name in _all_sites()
+        if not _NAME_RE.match(name)
+    ]
+    assert not bad, (
+        "trace event names must be lowercase 'cat/name' slugs "
+        "(see docs/observability.md):\n  " + "\n  ".join(bad)
+    )
