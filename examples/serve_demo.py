@@ -34,6 +34,13 @@ request trace so the two disciplines are directly comparable:
   ``--kill-round K`` kills replica r0 live so the self-healing path
   (drain, salvage, rebuild from factory, re-route) prints as it runs.
   See docs/reliability.md ("Serving fleet").
+- ``--mode cache`` — the prefix-cache tier
+  (:class:`rocket_tpu.serve.PrefixKVStore`): a seeded multi-turn trace
+  where 90% of every prompt is a session header shared across turns
+  runs cold and then cached, printing the store's hit rate and
+  occupancy and the TTFT p50/p95 cold-vs-cached comparison; outputs are
+  verified bit-equal between the passes.  ``--kv-bytes`` sets the LRU
+  byte budget.  See docs/performance.md ("Prefix cache").
 - ``--trace`` (implies ``--mode robust``) — arm the structured tracer
   (:mod:`rocket_tpu.observe.trace`): every round/admit/request gets a
   span, the demo prints the p50/p95 queue-wait/TTFT/TPOT/e2e table at
@@ -81,24 +88,27 @@ from rocket_tpu.models.transformer import (  # noqa: E402
 from rocket_tpu.ops.quant import quantize_params  # noqa: E402
 
 VOCAB, PROMPT, NEW, NDRAFT = 256, 16, 32, 4
+# --mode cache trace shape: longer prompts make the shared-prefix
+# fraction meaningful (36 of 40 tokens = 90%, an exact page multiple)
+CACHE_PROMPT, CACHE_PAGE, CACHE_TURNS = 240, 24, 4
 
 
-def _cfg(**kw):
+def _cfg(max_seq=PROMPT + NEW + NDRAFT, **kw):
     return TransformerConfig(
         vocab_size=VOCAB, hidden=128, n_layers=2, n_heads=4,
         # batched speculative decode needs n_draft slack past the
         # final token (the verify chunk can write that far)
-        max_seq=PROMPT + NEW + NDRAFT,
+        max_seq=max_seq,
         norm="layernorm", mlp="gelu", positions="learned",
         tie_embeddings=True, use_bias=True, attention="dot", **kw,
     )
 
 
-def _build():
+def _build(max_seq=PROMPT + NEW + NDRAFT):
     import flax.linen as nn
 
-    model = TransformerLM(_cfg())
-    draft = TransformerLM(_cfg(weights_int8=True))
+    model = TransformerLM(_cfg(max_seq=max_seq))
+    draft = TransformerLM(_cfg(max_seq=max_seq, weights_int8=True))
     init_prompt = jnp.zeros((1, PROMPT), jnp.int32)
     params = nn.meta.unbox(
         model.init(jax.random.PRNGKey(0), {"tokens": init_prompt})["params"]
@@ -483,6 +493,118 @@ def run_fleet(args, model, draft, params, draft_params, arrivals, prompts):
                 accepted=0, drafted=0, tally=tally)
 
 
+def run_cache(args, model, draft, params, draft_params, arrivals, prompts):
+    """Prefix-cache tier (:mod:`rocket_tpu.serve.kvstore`): a seeded
+    multi-turn trace where ~90% of every prompt is a session header
+    shared across the session's turns.  The SAME trace runs twice —
+    cold (no store) and cached (a :class:`PrefixKVStore` armed on the
+    loop) — and the TTFT p50/p95 comparison plus the store's hit-rate /
+    occupancy counters print at the end.  Outputs are bit-equal between
+    the two passes (the cache is a latency tier, never a correctness
+    tier)."""
+    from rocket_tpu.serve import (
+        Completed, PrefixKVStore, Request, ServingLoop,
+    )
+
+    R, B = args.requests, args.max_batch
+    sessions = max(1, R // CACHE_TURNS)
+    shared = int(CACHE_PROMPT * 0.9)          # 216 — 9 exact pages of 24
+    rng = np.random.default_rng(17)
+    headers = rng.integers(0, VOCAB, size=(sessions, shared))
+    tails = rng.integers(
+        0, VOCAB, size=(CACHE_TURNS, sessions, CACHE_PROMPT - shared))
+
+    def bat_factory():
+        return ContinuousBatcher(model, draft, params, draft_params,
+                                 total_len=CACHE_PROMPT + NEW,
+                                 n_draft=NDRAFT)
+
+    def turn_prompt(s, t):
+        return np.concatenate([headers[s], tails[t][s]]).astype(np.int32)
+
+    def serve_trace(store):
+        t0 = time.perf_counter()
+        loop = ServingLoop(bat_factory, max_batch=B,
+                           queue_capacity=max(args.queue_capacity, R),
+                           clock=lambda: time.perf_counter() - t0,
+                           kvstore=store)
+        outs = []
+        submit_at = {}
+        rid = 0
+        for t in range(CACHE_TURNS):
+            # a turn is submitted only after the previous turn's rows
+            # retired (and exported their pages) — the multi-turn shape
+            for s in range(sessions):
+                if rid >= R:
+                    break
+                submit_at[rid] = time.perf_counter() - t0
+                loop.submit(Request(rid=rid, prompt=turn_prompt(s, t),
+                                    session=s))
+                rid += 1
+            outs.extend(loop.run_until_idle(max_rounds=1_000_000))
+        total = time.perf_counter() - t0
+        summary = loop.latency.summary()
+        snap = loop.counters.snapshot()
+        loop.close()
+        lat = np.asarray([r.finished_at - submit_at[r.rid] for r in outs
+                          if isinstance(r, Completed)])
+        return outs, summary, snap, total, lat
+
+    # warm every executable BOTH passes dispatch (full prefill, suffix
+    # prefill, import scatter, round) so the comparison is dispatch time
+    warm = PrefixKVStore(page_tokens=CACHE_PAGE, capacity_bytes=1 << 28)
+    wloop = ServingLoop(bat_factory, max_batch=B, queue_capacity=4,
+                        kvstore=warm)
+    for t in range(2):
+        wloop.submit(Request(rid=f"w{t}", prompt=turn_prompt(0, t),
+                             session="warm"))
+        wloop.run_until_idle(max_rounds=1_000_000)
+    wloop.close()
+
+    store = PrefixKVStore(page_tokens=CACHE_PAGE,
+                          capacity_bytes=args.kv_bytes)
+    if args.metrics_port >= 0:
+        from rocket_tpu.serve import register_kvstore_source
+
+        register_kvstore_source([store])
+    cold_out, cold_sum, _, _, _ = serve_trace(None)
+    out, summary, snap, total, lat = serve_trace(store)
+
+    by_rid = {r.rid: r for r in cold_out}
+    mismatch = sum(
+        1 for r in out
+        if isinstance(r, Completed)
+        and not np.array_equal(r.tokens, by_rid[r.rid].tokens))
+    kv = store.snapshot()
+    frac = shared / CACHE_PROMPT
+    print(f"  [cache] trace: {sessions} sessions x {CACHE_TURNS} turns, "
+          f"{shared}/{CACHE_PROMPT} prompt tokens shared "
+          f"({frac:.0%} prefix)")
+    print(f"  [cache] hit rate {kv['hit_rate']:.0%} "
+          f"({int(kv['hits'])}/{int(kv['lookups'])} lookups, "
+          f"{int(kv['hit_tokens'])} prompt tokens served from pages)")
+    print(f"  [cache] store: {int(kv['pages'])} pages, "
+          f"{int(kv['occupancy_bytes'])}/{int(kv['capacity_bytes'])} "
+          f"bytes, {int(kv['evictions'])} evictions")
+    print(f"  [cache] {'':<8} {'ttft p50':>10} {'ttft p95':>10}")
+    for tag, s in (("cold", cold_sum), ("cached", summary)):
+        print(f"  [cache] {tag:<8} {s['ttft_ms/p50']:>9.1f}ms "
+              f"{s['ttft_ms/p95']:>9.1f}ms")
+    drop = 1.0 - summary["ttft_ms/p50"] / max(cold_sum["ttft_ms/p50"], 1e-9)
+    print(f"  [cache] cached TTFT p50 {drop:+.0%} vs cold "
+          f"(shared-prefill fraction {frac:.0%})")
+    print(f"  [cache] outputs bit-equal to cold pass: "
+          f"{'yes' if mismatch == 0 else f'NO ({mismatch} mismatches)'}")
+    if args.metrics_port >= 0:
+        from rocket_tpu.observe.export import unregister_source
+
+        unregister_source("serve_kvstore")
+
+    return dict(lat=lat * 1e3 if lat.size else np.zeros(1), total=total,
+                dispatches=int(snap["rounds"]), unit="rounds",
+                accepted=0, drafted=0)
+
+
 def _report(name, res, n_requests):
     lat = res["lat"]
     print(f"[{name}] served {n_requests} requests in {res['dispatches']} "
@@ -507,8 +629,11 @@ def main():
                         help="mean simulated inter-arrival gap")
     parser.add_argument("--mode",
                         choices=("group", "continuous", "both", "robust",
-                                 "fleet"),
+                                 "fleet", "cache"),
                         default="both")
+    parser.add_argument("--kv-bytes", type=int, default=1 << 28,
+                        help="[cache] prefix-store byte budget (LRU "
+                             "eviction past it)")
     parser.add_argument("--replicas", type=int, default=3,
                         help="[fleet] thread-backed decode replicas")
     parser.add_argument("--prefill-replicas", type=int, default=0,
@@ -565,7 +690,9 @@ def main():
         rng.exponential(args.arrival_ms / 1e3, size=args.requests)
     )
     prompts = rng.integers(0, VOCAB, size=(args.requests, PROMPT))
-    model, draft, params, draft_params = _build()
+    max_seq = (CACHE_PROMPT + NEW + NDRAFT if args.mode == "cache"
+               else PROMPT + NEW + NDRAFT)
+    model, draft, params, draft_params = _build(max_seq=max_seq)
 
     metrics = None
     if args.metrics_port >= 0:
@@ -580,7 +707,8 @@ def main():
               f"(JSON: /metrics.json) while the demo runs")
 
     runners = {"group": run_group, "continuous": run_continuous,
-               "robust": run_robust, "fleet": run_fleet}
+               "robust": run_robust, "fleet": run_fleet,
+               "cache": run_cache}
     modes = ["group", "continuous"] if args.mode == "both" else [args.mode]
     results = {}
     try:

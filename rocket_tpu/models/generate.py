@@ -42,7 +42,7 @@ from rocket_tpu.observe.ledger import get_retrace_ledger, ledger_call
 # loop's deliberate inline n_draft compiles run under ``expect_compile``).
 get_retrace_ledger().exempt(
     "generate/spec_prefill", "generate/spec_admit",
-    "generate/spec_import_row",
+    "generate/spec_import_row", "generate/spec_suffix_prefill",
 )
 
 
@@ -1002,6 +1002,105 @@ def _spec_import_row(state, row, buf1, n1, d1, c1_t, c1_d):
             (rounds, drafted, accepted))
 
 
+@functools.partial(
+    jax.jit, static_argnums=(0, 1),
+    static_argnames=("max_new_tokens", "eos_token", "sampled", "top_k"),
+)
+def _spec_suffix_prefill(model, draft_model, params, draft_params, prompt,
+                         suffix, pos0, cache_t, cache_d, key=None,
+                         temperature=0.0, *, max_new_tokens, eos_token,
+                         sampled=False, top_k=None, top_p=None):
+    """Continue a PARTIAL prefill: ``cache_t``/``cache_d`` already hold
+    K/V for the first ``pos0`` prompt positions (imported prefix pages,
+    zero beyond them) and ``suffix = prompt[:, pos0:]`` runs through the
+    decode path at positions ``pos0..P-1`` — building the exact round
+    state :func:`_spec_prefill_impl` would have built from a full
+    prefill.  Bit-equality argument: K/V at a position is a function of
+    the tokens at or before it only (causal attention over the WRITTEN
+    cache), so a suffix forward on top of the prefix's exact pages
+    reproduces the full prefill leaf for leaf — the prefix-cache oracle
+    in ``tests/test_kvstore.py`` asserts this for f32 and int8 layouts.
+    ``pos0`` is a traced scalar, so one compile covers every split point
+    sharing the same ``(P, S)`` shape pair; the edge is ledger-exempt
+    like the other shape-polymorphic admission edges."""
+    B, P = prompt.shape
+    S = suffix.shape[1]
+    total = P + max_new_tokens
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    pos = jnp.broadcast_to(
+        pos0 + jnp.arange(S, dtype=jnp.int32)[None, :], (B, S)
+    )
+    out, mut = model.apply(
+        {"params": params, "cache": cache_t},
+        {"tokens": suffix, "positions": pos},
+        decode=True, mutable=["cache"],
+    )
+    cache_t = mut["cache"]
+    last = out["logits"][:, -1].astype(jnp.float32)
+    _, mut_d = draft_model.apply(
+        {"params": draft_params, "cache": cache_d},
+        {"tokens": suffix, "positions": pos},
+        decode=True, mutable=["cache"],
+    )
+    cache_d = mut_d["cache"]
+    if sampled:
+        key, kg = jax.random.split(key)
+        g = jax.random.categorical(
+            kg, _truncate_logits(last / temperature, top_k, top_p),
+            axis=-1,
+        ).astype(jnp.int32)
+    else:
+        g = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    buf = jnp.zeros((B, total), jnp.int32)
+    buf = jax.lax.dynamic_update_slice(buf, prompt, (0, 0))
+    buf = buf.at[:, P].set(g)
+    n_tok = jnp.full((B,), P + 1, jnp.int32)
+    done = (g == eos_token) if eos_token is not None \
+        else jnp.zeros((B,), bool)
+    stats0 = (jnp.zeros((), jnp.int32),
+              jnp.zeros((B,), jnp.int32),
+              jnp.zeros((B,), jnp.int32))
+    return buf, n_tok, done, cache_t, cache_d, key, stats0
+
+
+@dataclasses.dataclass
+class KVPage:
+    """One fixed-granularity slice of a prefilled row: ``page_tokens``
+    consecutive token ids plus both models' K/V cache slots for exactly
+    those positions.  Rank-4 cache leaves (int8 payload and its rank-4
+    scales alike) are sliced along the slot axis; scalar leaves
+    (``cache_index``) ride along so :meth:`KVHandoff.from_pages` can
+    rebuild a tree with the original structure.  Leaves are OWNED copies
+    (never views), so a page's ``nbytes`` is its true retained size —
+    the unit the :class:`~rocket_tpu.serve.kvstore.PrefixKVStore` byte
+    budget accounts in."""
+
+    tokens: Any
+    cache_t: Any
+    cache_d: Any
+
+    @property
+    def page_tokens(self) -> int:
+        return int(self.tokens.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        leaves = jax.tree_util.tree_leaves(
+            (self.tokens, self.cache_t, self.cache_d))
+        return int(sum(leaf.nbytes for leaf in leaves))
+
+    def layout_sig(self):
+        """Shape/dtype signature of the cache leaves (token count
+        excluded from shapes only via the slot axis, which IS part of
+        the signature — pages of different granularity never mix)."""
+        return tuple(
+            (tuple(leaf.shape), str(leaf.dtype))
+            for leaf in jax.tree_util.tree_leaves(
+                (self.cache_t, self.cache_d))
+        )
+
+
 @dataclasses.dataclass
 class KVHandoff:
     """One request's finished prefill, packaged for a cross-replica
@@ -1041,6 +1140,100 @@ class KVHandoff:
         telemetry; int8 caches are ~4x smaller than f32 here."""
         return int(sum(leaf.nbytes
                        for leaf in jax.tree_util.tree_leaves(self._tree())))
+
+    def split_pages(self, page_tokens: int) -> "list[KVPage]":
+        """Split this row's REUSABLE prefix into fixed-size
+        :class:`KVPage`\\ s (host copies, oldest first).
+
+        The reusable prefix is the first ``n_tok - 1`` positions: each
+        holds K/V computed from the accepted token at that position,
+        while the FINAL token's slot can still be a stale speculative
+        write (the round loop re-feeds it instead of reading it back,
+        so decode never notices — but a prefix consumer would).  Only
+        full pages split out; the remainder is the consumer's suffix to
+        re-prefill."""
+        if page_tokens < 1:
+            raise ValueError(f"page_tokens must be >= 1, got {page_tokens}")
+        usable = int(np.asarray(self.n_tok)[0]) - 1
+        n_pages = max(0, usable) // page_tokens
+        if n_pages == 0:
+            return []
+        buf = np.asarray(self.buf)
+        cache_t, cache_d = jax.tree_util.tree_map(
+            np.asarray, (self.cache_t, self.cache_d))
+
+        def page_slice(a, lo, hi):
+            # owned copies: a view would retain the whole parent buffer
+            # and break the store's byte accounting
+            if getattr(a, "ndim", 0) == 4:
+                return np.ascontiguousarray(a[:, lo:hi])
+            return np.asarray(a).copy()
+
+        pages = []
+        for i in range(n_pages):
+            lo, hi = i * page_tokens, (i + 1) * page_tokens
+            pages.append(KVPage(
+                tokens=buf[0, lo:hi].copy(),
+                cache_t=jax.tree_util.tree_map(
+                    lambda a: page_slice(a, lo, hi), cache_t),
+                cache_d=jax.tree_util.tree_map(
+                    lambda a: page_slice(a, lo, hi), cache_d),
+            ))
+        return pages
+
+    @classmethod
+    def from_pages(cls, pages, *, total_len: int, slots_t: int,
+                   slots_d: int) -> "KVHandoff":
+        """Reassemble contiguous pages (oldest first) into a
+        PREFIX-shaped handoff: ``buf`` holds the covered tokens,
+        ``n_tok`` the covered count, ``done=False``, and every cache
+        leaf is zero past the covered slots — exactly what a fresh
+        prefill's untouched tail holds, so a suffix prefill continued
+        on top (:func:`_spec_suffix_prefill`) is bit-equal to a full
+        one.  ``slots_t``/``slots_d`` give each model's total cache
+        slot count (``max_seq`` for the position==slot layout the page
+        index assumes); the scalar ``cache_index`` leaves are set to
+        the covered frontier."""
+        if not pages:
+            raise ValueError("from_pages needs at least one page")
+        covered = sum(p.page_tokens for p in pages)
+        if covered + 1 > total_len:
+            raise ValueError(
+                f"pages cover {covered} tokens; total_len ({total_len}) "
+                f"needs room for at least one generated token"
+            )
+
+        def join(trees, slots):
+            if covered > slots:
+                raise ValueError(
+                    f"pages cover {covered} tokens but the cache has "
+                    f"only {slots} slots"
+                )
+
+            def leaf_join(*leaves):
+                a0 = np.asarray(leaves[0])
+                if a0.ndim != 4:
+                    return np.asarray(covered, a0.dtype)  # cache_index
+                cat = np.concatenate(
+                    [np.asarray(leaf) for leaf in leaves], axis=1)
+                pad = np.zeros(
+                    (cat.shape[0], slots - cat.shape[1]) + cat.shape[2:],
+                    cat.dtype,
+                )
+                return np.concatenate([cat, pad], axis=1)
+
+            return jax.tree_util.tree_map(leaf_join, *trees)
+
+        buf = np.zeros((1, total_len), np.int32)
+        buf[0, :covered] = np.concatenate(
+            [np.asarray(p.tokens, np.int32) for p in pages])
+        return cls(
+            buf=buf,
+            n_tok=np.array([covered], np.int32),
+            done=np.array([False]),
+            cache_t=join([p.cache_t for p in pages], slots_t),
+            cache_d=join([p.cache_d for p in pages], slots_d),
+        )
 
 
 def export_kv_row(state, row: int) -> KVHandoff:
@@ -1308,6 +1501,86 @@ class ContinuousBatcher:
             max_new_tokens=self.total_len - P, **self._kw(),
         )
         return export_kv_row(state1, 0)
+
+    @property
+    def prefix_cache_ok(self) -> bool:
+        """Whether rows can be rebuilt from imported prefix pages: the
+        page index assumes the position==slot cache layout, and a
+        rolling cache remaps slots mod the window — its pages are not
+        content-addressable by token prefix."""
+        return not any(
+            getattr(m.config, "decode_rolling_cache", False)
+            for m in (self._model, self._draft_model)
+        )
+
+    def prefill_suffix_handoff(self, prompt_row, prefix: "KVHandoff", *,
+                               key=None) -> "KVHandoff":
+        """Prefill ONLY the uncached suffix of ``prompt_row`` on top of
+        a prefix-shaped handoff (:meth:`KVHandoff.from_pages`) and
+        package the complete row as a :class:`KVHandoff` — the
+        prefix-cache admission path: cached pages import as data, the
+        suffix pays the only model forward.  Greedy output is bit-equal
+        to :meth:`prefill_handoff` of the full prompt (the kvstore
+        oracle); the admit counter advances exactly like
+        :meth:`prefill_handoff`, so key discipline is unchanged."""
+        prompt_row = jnp.asarray(prompt_row, jnp.int32)
+        if prompt_row.ndim == 1:
+            prompt_row = prompt_row[None, :]
+        if prompt_row.ndim != 2 or prompt_row.shape[0] != 1 \
+                or prompt_row.shape[1] < 1:
+            raise ValueError(
+                f"prefill_suffix_handoff() needs a single non-empty "
+                f"prompt row ([P] or [1, P]), got shape "
+                f"{tuple(jnp.asarray(prompt_row).shape)}"
+            )
+        if not self.prefix_cache_ok:
+            raise ValueError(
+                "prefix-cache import needs the position==slot cache "
+                "layout; a decode_rolling_cache model remaps slots"
+            )
+        P = prompt_row.shape[1]
+        if P + 1 > self.total_len:
+            raise ValueError(
+                f"prompt length {P} + 1 exceeds total_len "
+                f"({self.total_len})"
+            )
+        C = int(np.asarray(prefix.n_tok)[0])
+        if not 0 < C < P:
+            raise ValueError(
+                f"cached prefix must cover 1..P-1 tokens, got {C} of "
+                f"{P} (the final position's logits must be recomputed)"
+            )
+        pfx = np.asarray(prefix.buf)[0, :C]
+        if not np.array_equal(pfx, np.asarray(prompt_row)[0, :C]):
+            raise ValueError(
+                f"prefix handoff tokens do not match the prompt's first "
+                f"{C} tokens — wrong store entry (hash collision or a "
+                f"mixed-up session)"
+            )
+        if key is None:
+            self._admits += 1
+            key = jax.random.fold_in(self._rng, self._admits)
+        suffix = prompt_row[:, C:]
+        state1 = ledger_call(
+            _spec_suffix_prefill, "generate/spec_suffix_prefill",
+            self._model, self._draft_model, self._params,
+            self._draft_params, prompt_row, suffix, jnp.int32(C),
+            prefix.cache_t, prefix.cache_d, key, self._temperature,
+            max_new_tokens=self.total_len - P, **self._kw(),
+        )
+        return export_kv_row(state1, 0)
+
+    def prefill_from_pages(self, prompt_row, pages, *,
+                           key=None) -> "KVHandoff":
+        """Convenience over :meth:`prefill_suffix_handoff`: reassemble
+        ``pages`` with THIS batcher's slot layout
+        (:meth:`KVHandoff.from_pages`) and run the suffix prefill."""
+        prefix = KVHandoff.from_pages(
+            pages, total_len=self.total_len,
+            slots_t=int(self._model.config.max_seq),
+            slots_d=int(self._draft_model.config.max_seq),
+        )
+        return self.prefill_suffix_handoff(prompt_row, prefix, key=key)
 
     def admit_prefilled(self, row: int, handoff: "KVHandoff", *,
                         preempt: bool = False) -> None:

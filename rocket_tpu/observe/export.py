@@ -8,7 +8,10 @@ Everything observable already lives in flat ``Dict[str, float]`` form:
 - a **source registry** (:func:`register_source`) any subsystem can hang
   its snapshot callable on — :func:`collect` merges all of them, always
   including the goodput and retrace ledgers and the device memory
-  watermarks;
+  watermarks (the prefix-cache tier registers itself through
+  ``serve.kvstore.register_kvstore_source`` →
+  ``rocket_tpu_serve_kvstore_*`` gauges, with ``hit_rate`` recomputed
+  from the summed hits/lookups rather than summed);
 - a stdlib-only **Prometheus text** formatter (:func:`prometheus_text`)
   and an opt-in ``/metrics`` HTTP endpoint (:class:`MetricsServer`, port
   chosen by the caller; ``port=0`` lets the OS pick — tests use that);

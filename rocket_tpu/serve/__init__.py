@@ -6,6 +6,12 @@ reliability") for the operator view.
 """
 
 from rocket_tpu.serve.fleet import PrefillReplica, Replica
+from rocket_tpu.serve.kvstore import (
+    PrefixKVStore,
+    PrefixMatch,
+    page_hashes,
+    register_kvstore_source,
+)
 from rocket_tpu.serve.loop import ServingLoop
 from rocket_tpu.serve.metrics import (
     FleetCounters,
@@ -45,6 +51,8 @@ __all__ = [
     "HealthState",
     "Overloaded",
     "PrefillReplica",
+    "PrefixKVStore",
+    "PrefixMatch",
     "Replica",
     "ReplicaId",
     "Request",
@@ -52,4 +60,6 @@ __all__ = [
     "ServeCounters",
     "ServeLatency",
     "ServingLoop",
+    "page_hashes",
+    "register_kvstore_source",
 ]

@@ -225,6 +225,11 @@ class Replica:
         # open a window where a concurrent submit lands in the old,
         # already-salvaged loop and the request is stranded
         self.loop = self._build()
+        kv = getattr(self.loop, "kvstore", None)
+        if kv is not None:
+            # pins held by the dead loop's in-flight admits died with it;
+            # the store itself (host-side numpy) survives the rebuild
+            kv.unpin_all()
         self._dead = None
         if was_threaded:
             self.start()

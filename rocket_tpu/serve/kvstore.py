@@ -1,0 +1,357 @@
+"""Prefix-cache tier — paged KV reuse across requests and sessions.
+
+The millions-of-users serving workload is dominated by shared prefixes
+(system prompts, few-shot headers, multi-turn sessions), yet a plain
+admission pays full prefill per request.  This module is the vLLM-style
+fix: a host-side, content-addressed page store over the exact
+:class:`~rocket_tpu.models.generate.KVHandoff` row state the fleet
+already moves between batchers.
+
+- **Pages** — :meth:`KVHandoff.split_pages` slices a finished row's
+  reusable prefix (first ``n_tok - 1`` positions) into fixed-size
+  :class:`~rocket_tpu.models.generate.KVPage`\\ s: ``page_tokens`` token
+  ids plus both models' K/V cache slots for those positions, f32 or
+  int8-with-rank-4-scales alike.
+- **Content addressing** — :func:`page_hashes` builds a rolling hash
+  chain over token pages; page ``i``'s digest commits to every token in
+  pages ``0..i``, so identical prefixes from different requests dedupe
+  to identical keys and a lookup is a simple walk down the chain.
+- **Eviction** — strict LRU under ``capacity_bytes``; matched pages are
+  PINNED while an admission imports them (in-flight pages never evict)
+  and occupancy never exceeds the budget (an insert that cannot fit
+  after evicting every unpinned entry is rejected, not squeezed in).
+  Touch order is deepest-page-least-recent, so a cold chain loses its
+  leaves first and the shared root last.
+- **Counters** — hits/misses/evictions/occupancy emit as
+  ``serve/kvstore/*`` trace events and aggregate via
+  :func:`register_kvstore_source` into ``observe.export`` so
+  ``/metrics`` serves ``rocket_tpu_serve_kvstore_*`` gauges fleet-wide.
+
+Consumers: :class:`~rocket_tpu.serve.ServingLoop` looks up the longest
+cached prefix at admission and prefills only the uncached suffix
+(:meth:`ContinuousBatcher.prefill_from_pages`), exporting completed
+rows' pages back on retire; :class:`~rocket_tpu.serve.FleetRouter`
+routes session turns to the replica whose store holds their pages.
+Greedy decode from a cached prefix is bit-equal to decode after a full
+prefill (``tests/test_kvstore.py`` oracle, f32 and int8).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from rocket_tpu.models.generate import KVHandoff, KVPage
+from rocket_tpu.observe.trace import get_tracer
+
+__all__ = [
+    "PrefixKVStore",
+    "PrefixMatch",
+    "page_hashes",
+    "register_kvstore_source",
+]
+
+
+def page_hashes(tokens, page_tokens: int, *,
+                limit: Optional[int] = None) -> List[bytes]:
+    """Rolling content-hash chain over fixed-size token pages.
+
+    Page ``i``'s digest is ``H(digest_{i-1} || tokens[i*pt:(i+1)*pt])``
+    seeded with the page granularity, so a digest content-addresses the
+    ENTIRE prefix ending at its page — identical prefixes dedupe no
+    matter which request produced them, and different granularities
+    never collide.  ``limit`` caps the tokens hashed (a consumer that
+    must re-prefill at least the final position passes ``len - 1``).
+    Only full pages hash; the tail remainder is never addressable."""
+    toks = np.ascontiguousarray(np.asarray(tokens, np.int32).reshape(-1))
+    if limit is not None:
+        toks = toks[:max(0, int(limit))]
+    out: List[bytes] = []
+    prev = b"rocket_tpu/kvstore/%d" % page_tokens
+    for i in range(toks.shape[0] // page_tokens):
+        h = hashlib.blake2b(prev, digest_size=16)
+        h.update(toks[i * page_tokens:(i + 1) * page_tokens].tobytes())
+        prev = h.digest()
+        out.append(prev)
+    return out
+
+
+@dataclass
+class PrefixMatch:
+    """A successful longest-prefix lookup: ``pages`` (oldest first) and
+    their chain hashes.  The entries are PINNED until the consumer calls
+    :meth:`PrefixKVStore.release` — import them, then release."""
+
+    hashes: List[bytes]
+    pages: List[KVPage]
+
+    @property
+    def tokens(self) -> int:
+        return sum(p.page_tokens for p in self.pages)
+
+
+class _Entry:
+    __slots__ = ("page", "nbytes", "pins")
+
+    def __init__(self, page: KVPage, nbytes: int) -> None:
+        self.page = page
+        self.nbytes = nbytes
+        self.pins = 0
+
+
+class PrefixKVStore:
+    """Host-side paged KV store with a content-addressed prefix index
+    and LRU eviction under a byte budget.
+
+    ``page_tokens`` fixes the reuse granularity (smaller pages = finer
+    prefix matches, more hash/table overhead).  ``capacity_bytes`` is a
+    hard budget: eviction frees exactly enough LRU unpinned entries to
+    fit each insert, and an insert that still cannot fit is rejected
+    (later pages of the same chain are skipped too — a chain with a
+    hole is unreachable past it, so storing them would be dead weight).
+
+    Thread-safe (one lock around the table); all payloads are host
+    numpy, so the store never holds device memory.  One store per
+    replica is the intended deployment — a page's cache layout must
+    match the consuming batcher, and the first insert pins the store's
+    layout signature (a mismatched insert fails loudly rather than
+    poisoning a future import).
+
+    ``snapshot()`` returns flat float counters; ``hit_rate`` there is
+    per-store — when merging snapshots across replicas, recompute it
+    from the summed ``hits``/``lookups`` (``register_kvstore_source``
+    does) instead of summing rates."""
+
+    def __init__(self, *, page_tokens: int = 16,
+                 capacity_bytes: int = 1 << 30,
+                 name: Optional[str] = None,
+                 tracer: Optional[Any] = None) -> None:
+        if page_tokens < 1:
+            raise ValueError(
+                f"page_tokens must be >= 1, got {page_tokens}")
+        if capacity_bytes < 1:
+            raise ValueError(
+                f"capacity_bytes must be >= 1, got {capacity_bytes}")
+        self.page_tokens = int(page_tokens)
+        self.capacity_bytes = int(capacity_bytes)
+        self.name = name
+        self._tracer = tracer if tracer is not None else get_tracer()
+        self._lock = threading.Lock()
+        self._table: "OrderedDict[bytes, _Entry]" = OrderedDict()
+        self._layout_sig = None
+        self.occupancy_bytes = 0
+        self.lookups = 0
+        self.hits = 0
+        self.misses = 0
+        self.hit_tokens = 0
+        self.inserts = 0
+        self.dedup_hits = 0
+        self.evictions = 0
+        self.evicted_bytes = 0
+        self.rejected = 0
+
+    def __len__(self) -> int:
+        return len(self._table)
+
+    # -- lookup / pinning ----------------------------------------------
+
+    def lookup(self, tokens) -> Optional[PrefixMatch]:
+        """Longest cached prefix of ``tokens`` at page granularity,
+        capped at ``len(tokens) - 1`` (the consumer must recompute the
+        final position's logits).  Matched entries are LRU-touched and
+        PINNED until :meth:`release`; ``None`` on a total miss."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        hashes = page_hashes(toks, self.page_tokens,
+                             limit=toks.shape[0] - 1)
+        with self._lock:
+            self.lookups += 1
+            matched: List[bytes] = []
+            for h in hashes:
+                if h not in self._table:
+                    break
+                matched.append(h)
+            if not matched:
+                self.misses += 1
+                self._tracer.counter("serve/kvstore/miss", 1)
+                return None
+            pages = []
+            for h in matched:
+                entry = self._table[h]
+                entry.pins += 1
+                pages.append(entry.page)
+            self._touch(matched)
+            self.hits += 1
+            match = PrefixMatch(hashes=matched, pages=pages)
+            self.hit_tokens += match.tokens
+            self._tracer.counter("serve/kvstore/hit", 1,
+                                 tokens=match.tokens)
+            return match
+
+    def release(self, match: PrefixMatch) -> None:
+        """Unpin a match's entries (call once the import copied them)."""
+        with self._lock:
+            for h in match.hashes:
+                entry = self._table.get(h)
+                if entry is not None and entry.pins > 0:
+                    entry.pins -= 1
+
+    def unpin_all(self) -> None:
+        """Clear every pin — the heal path's leak stopper: a consumer
+        that died between :meth:`lookup` and :meth:`release` must not
+        hold its pages immortal."""
+        with self._lock:
+            for entry in self._table.values():
+                entry.pins = 0
+
+    # -- insertion / eviction ------------------------------------------
+
+    def insert(self, handoff: KVHandoff) -> int:
+        """Split a finished row's reusable prefix into pages and store
+        the ones not already present; returns the number newly stored.
+        The retire half of the prefix-cache flow."""
+        host = handoff.to_host()
+        pages = host.split_pages(self.page_tokens)
+        if not pages:
+            return 0
+        hashes = page_hashes(
+            np.asarray(host.buf)[0], self.page_tokens,
+            limit=int(np.asarray(host.n_tok)[0]) - 1,
+        )
+        return self.put_pages(hashes[:len(pages)], pages)
+
+    def put_pages(self, hashes: Iterable[bytes],
+                  pages: Iterable[KVPage]) -> int:
+        """Store a contiguous page chain under its chain hashes.  Stops
+        at the first page that cannot fit: pages past a hole are
+        unreachable by the chain walk.  Pages of THIS chain (stored or
+        deduped) are pinned for the duration of the call — eviction
+        pressure from the chain's own later pages must never punch a
+        hole in its earlier ones."""
+        new = 0
+        own: List[_Entry] = []
+        with self._lock:
+            stored: List[bytes] = []
+            try:
+                for h, page in zip(hashes, pages):
+                    entry = self._table.get(h)
+                    if entry is not None:
+                        self.dedup_hits += 1
+                        entry.pins += 1
+                        own.append(entry)
+                        stored.append(h)
+                        continue
+                    self._check_layout(page)
+                    nbytes = int(page.nbytes)
+                    if nbytes > self.capacity_bytes \
+                            or not self._evict_to_fit(nbytes):
+                        self.rejected += 1
+                        break
+                    entry = _Entry(page, nbytes)
+                    entry.pins += 1
+                    own.append(entry)
+                    self._table[h] = entry
+                    self.occupancy_bytes += nbytes
+                    self.inserts += 1
+                    new += 1
+                    stored.append(h)
+            finally:
+                for entry in own:
+                    if entry.pins > 0:
+                        entry.pins -= 1
+            self._touch(stored)
+        if new:
+            self._tracer.counter("serve/kvstore/stored", new)
+        return new
+
+    def _touch(self, chain: List[bytes]) -> None:
+        """LRU-refresh a chain so its ROOT is most recent: eviction then
+        takes a cold chain's deepest page first, keeping the widely
+        shared roots alive longest (leaf-first eviction)."""
+        for h in reversed(chain):
+            if h in self._table:
+                self._table.move_to_end(h)
+
+    def _evict_to_fit(self, nbytes: int) -> bool:
+        """Evict LRU unpinned entries until ``nbytes`` fits under the
+        budget; ``False`` when everything left is pinned and it still
+        does not fit.  Evicts exactly enough — never more."""
+        while self.occupancy_bytes + nbytes > self.capacity_bytes:
+            victim = None
+            for h, entry in self._table.items():  # LRU first
+                if entry.pins == 0:
+                    victim = h
+                    break
+            if victim is None:
+                return False
+            entry = self._table.pop(victim)
+            self.occupancy_bytes -= entry.nbytes
+            self.evictions += 1
+            self.evicted_bytes += entry.nbytes
+            self._tracer.counter("serve/kvstore/evict", 1,
+                                 nbytes=entry.nbytes)
+        return True
+
+    def _check_layout(self, page: KVPage) -> None:
+        sig = page.layout_sig()
+        if self._layout_sig is None:
+            self._layout_sig = sig
+        elif sig != self._layout_sig:
+            raise ValueError(
+                "page cache layout does not match this store's (mixed "
+                "int8/f32 caches or different model shapes?) — use one "
+                "store per batcher layout"
+            )
+
+    # -- observability -------------------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat float counters for export/merge; see class docstring
+        for the ``hit_rate`` merge caveat."""
+        with self._lock:
+            pinned = sum(1 for e in self._table.values() if e.pins > 0)
+            return {
+                "lookups": float(self.lookups),
+                "hits": float(self.hits),
+                "misses": float(self.misses),
+                "hit_rate": (float(self.hits) / self.lookups
+                             if self.lookups else 0.0),
+                "hit_tokens": float(self.hit_tokens),
+                "inserts": float(self.inserts),
+                "dedup_hits": float(self.dedup_hits),
+                "evictions": float(self.evictions),
+                "evicted_bytes": float(self.evicted_bytes),
+                "rejected": float(self.rejected),
+                "occupancy_bytes": float(self.occupancy_bytes),
+                "capacity_bytes": float(self.capacity_bytes),
+                "pages": float(len(self._table)),
+                "pinned": float(pinned),
+            }
+
+
+def register_kvstore_source(stores, name: str = "serve_kvstore") -> str:
+    """Register an aggregate snapshot over ``stores`` as an
+    ``observe.export`` source: ``/metrics`` (and ``metrics.json``) then
+    serve ``rocket_tpu_serve_kvstore_*`` gauges summed fleet-wide, with
+    ``hit_rate`` recomputed from the summed hits/lookups rather than
+    summed per store.  Returns the source name (pass it to
+    ``observe.export.unregister_source`` on teardown)."""
+    from rocket_tpu.observe.export import register_source
+
+    stores = list(stores)
+
+    def _collect() -> Dict[str, float]:
+        agg: Dict[str, float] = {}
+        for store in stores:
+            for k, v in store.snapshot().items():
+                agg[k] = agg.get(k, 0.0) + v
+        agg["hit_rate"] = (agg.get("hits", 0.0) / agg["lookups"]
+                           if agg.get("lookups") else 0.0)
+        return agg
+
+    register_source(name, _collect)
+    return name

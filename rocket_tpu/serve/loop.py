@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from rocket_tpu.models.generate import export_kv_row
 from rocket_tpu.observe.ledger import expect_compile, get_goodput
 from rocket_tpu.observe.recorder import active_recorder
 from rocket_tpu.observe.trace import get_tracer
@@ -113,6 +114,11 @@ class ServingLoop:
     watchdog always uses real time.  ``kv_cache_int8`` (None = defer to
     the factory's model configs) forces the int8 KV-cache layout on or
     off for every batcher the loop builds — including watchdog rebuilds.
+    ``kvstore`` (a :class:`~rocket_tpu.serve.kvstore.PrefixKVStore`)
+    arms the prefix-cache tier: admissions import the longest cached
+    prefix and prefill only the uncached suffix, retiring rows export
+    their pages back — outputs stay bit-equal to serving without the
+    store.
     """
 
     def __init__(
@@ -133,6 +139,7 @@ class ServingLoop:
         logger: Optional[logging.Logger] = None,
         kv_cache_int8: Optional[bool] = None,
         replica_id: Optional[str] = None,
+        kvstore: Optional[Any] = None,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -179,8 +186,20 @@ class ServingLoop:
         self._carry: Optional[Tuple[np.ndarray, np.ndarray]] = None
         self._compiled_drafts: set = set()
 
+        # Prefix-cache tier (ISSUE 11): a PrefixKVStore shared across
+        # this loop's lifetime (watchdog rebuilds included — pages are
+        # host-side numpy, a wedged device step cannot poison them).
+        # Admission looks up the longest cached prefix and prefills only
+        # the uncached suffix; completing rows export their pages back.
+        self.kvstore = kvstore
+
         self._bat = self._build_batcher()
         self.base_n_draft = int(self._bat.n_draft)
+        if self.kvstore is not None and not self._bat.prefix_cache_ok:
+            raise ValueError(
+                "kvstore needs the position==slot cache layout; the "
+                "factory's models use decode_rolling_cache"
+            )
         self._warm_start(self._bat)
 
     # -- lifecycle -----------------------------------------------------
@@ -418,19 +437,37 @@ class ServingLoop:
         wait_ms = (now - submitted) * 1e3 if submitted is not None else 0.0
         self.latency.queue_wait_ms.record(wait_ms)
         handoff = getattr(req, "_handoff", None)
+        match = None
+        if handoff is None and self.kvstore is not None:
+            match = self.kvstore.lookup(prompt)
         # The admit IS the row's prefill (the batcher rebuilds the row's
         # cache from the prompt) — one span covers admission + prefill.
         # A handed-off request skips the prefill: its KV rows import as
         # one cheap scatter dispatch (the prefill/decode lane split).
+        # A kvstore prefix hit imports the cached pages and prefills
+        # only the uncached suffix — same scatter path, same bit-equal
+        # outcome as a full prefill.
         with self._tracer.span(
             "serve/admit", rid=req.rid, row=row,
             prompt_len=int(prompt.shape[0]), queue_wait_ms=wait_ms,
             prefilled=handoff is not None,
+            kv_hit_tokens=match.tokens if match is not None else 0,
         ):
             if handoff is not None:
                 self._bat.admit_prefilled(row, handoff)
                 req._handoff = None
                 self.counters.prefilled_admits += 1
+            elif match is not None:
+                try:
+                    self._bat.admit_prefilled(
+                        row,
+                        self._bat.prefill_from_pages(
+                            prompt[None, :], match.pages),
+                    )
+                finally:
+                    self.kvstore.release(match)
+                self.counters.kv_hits += 1
+                self.counters.kv_hit_tokens += match.tokens
             else:
                 self._bat.admit(row, prompt[None, :])
         self._rows[row] = _Row(req, now, prompt.shape[0], budget,
@@ -594,6 +631,7 @@ class ServingLoop:
             produced = n - occ.prompt_len
             if bool(done_h[row]):
                 toks, nt = self._bat.row_tokens(row)
+                self._store_row(row)
                 self.counters.completed += 1
                 self._finish_latency(occ, now, nt, "serve/complete", row)
                 self._results.append(Completed(
@@ -603,6 +641,7 @@ class ServingLoop:
                 self._rows[row] = None
             elif occ.req.deadline is not None and occ.req.deadline <= now:
                 toks, nt = self._bat.row_tokens(row)
+                self._store_row(row)
                 self._bat.retire(row)
                 self.counters.evicted_deadline += 1
                 self._finish_latency(occ, now, n, "serve/evict", row)
@@ -613,6 +652,7 @@ class ServingLoop:
                 self._rows[row] = None
             elif produced >= occ.budget:
                 toks, nt = self._bat.row_tokens(row)
+                self._store_row(row)
                 self._bat.retire(row)
                 truncated = occ.budget < occ.requested
                 if truncated:
@@ -625,6 +665,19 @@ class ServingLoop:
                     meta=self._meta(),
                 ))
                 self._rows[row] = None
+
+    def _store_row(self, row: int) -> None:
+        """Export a retiring row's reusable prefix pages into the
+        kvstore — the retire half of the prefix-cache flow.  Never
+        raises: the store is an accelerator, not a dependency."""
+        if self.kvstore is None:
+            return
+        try:
+            with self._tracer.span("serve/kvstore_export", row=row):
+                self.kvstore.insert(export_kv_row(self._bat.state, row))
+        except Exception:
+            self._log.warning("serve: kvstore export failed",
+                              exc_info=True)
 
     def _finish_latency(self, occ: _Row, now: float, n_tok: int,
                         event: str, row: int) -> None:

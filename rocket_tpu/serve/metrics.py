@@ -23,6 +23,8 @@ class ServeCounters:
         self.submitted = 0
         self.admitted = 0
         self.prefilled_admits = 0   # admissions that imported a KVHandoff
+        self.kv_hits = 0            # admissions served from the prefix cache
+        self.kv_hit_tokens = 0      # prompt tokens skipped via cached pages
         self.completed = 0
         self.shed_overload = 0      # bounded-queue / draining rejections
         self.shed_deadline = 0      # shed before prefill (stage='queue')
@@ -54,6 +56,8 @@ class ServeCounters:
             "submitted": float(self.submitted),
             "admitted": float(self.admitted),
             "prefilled_admits": float(self.prefilled_admits),
+            "kv_hits": float(self.kv_hits),
+            "kv_hit_tokens": float(self.kv_hit_tokens),
             "completed": float(self.completed),
             "shed_overload": float(self.shed_overload),
             "shed_deadline": float(self.shed_deadline),
@@ -119,6 +123,8 @@ class FleetCounters:
         self.heals = 0              # replica rebuilds the router ordered
         self.shed_saturated = 0     # every replica refused (fleet-level shed)
         self.deadline_shed_prefill = 0  # deadline passed in the prefill lane
+        self.affinity_routed = 0    # session requests routed to their replica
+        self.affinity_invalidated = 0   # session stamps dropped by a heal
 
     def snapshot(self) -> Dict[str, float]:
         return {
@@ -130,4 +136,6 @@ class FleetCounters:
             "heals": float(self.heals),
             "shed_saturated": float(self.shed_saturated),
             "deadline_shed_prefill": float(self.deadline_shed_prefill),
+            "affinity_routed": float(self.affinity_routed),
+            "affinity_invalidated": float(self.affinity_invalidated),
         }

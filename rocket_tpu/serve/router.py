@@ -16,6 +16,14 @@ request — now prefill-free — to a decode replica, whose admission is one
 cheap scatter dispatch.  Long prompts burn prefill-lane time; decode
 TPOT stays flat (the acceptance test drives exactly this).
 
+Session affinity.  A request carrying ``session`` routes to the replica
+that served the session's previous turn — the replica whose prefix-cache
+store (`kvstore.py`) holds the session's KV pages — so multi-turn
+prefill reuse survives the fleet hop.  Affinity is a HINT, never a
+correctness dependency: a saturated or unhealthy sticky replica falls
+back to least-loaded (the turn just pays a cold prefill there), and a
+heal invalidates every stamp pointing at the rebuilt replica.
+
 Exactly-once results.  Every request submitted to the router resolves to
 EXACTLY ONE typed result, wherever it traveled: replica submits run
 side-effect-free (``record_rejection=False``), salvaged requests from a
@@ -38,6 +46,7 @@ from rocket_tpu.serve.types import (
     DeadlineExceeded,
     HealthState,
     Overloaded,
+    ReplicaId,
     Request,
 )
 
@@ -80,6 +89,9 @@ class FleetRouter:
         self._lock = threading.RLock()
         self._results: List[Any] = []
         self._retry: List[Request] = []
+        # session key -> decode replica that served the session's last
+        # turn (and so holds its prefix pages); pruned on heal
+        self._affinity: Dict[Any, ReplicaId] = {}
         ids = [r.replica_id for r in self.replicas] \
             + [r.replica_id for r in self.prefill_replicas]
         if len(set(ids)) != len(ids):
@@ -127,10 +139,27 @@ class FleetRouter:
                    if r.health is HealthState.SERVING]
         degraded = [r for r in self.replicas
                     if r.health is HealthState.DEGRADED]
-        for rep in self._least_loaded(serving) + self._least_loaded(degraded):
+        candidates = self._least_loaded(serving) + self._least_loaded(degraded)
+        sticky_id = None
+        if req.session is not None:
+            sticky_id = self._affinity.get(req.session)
+            if sticky_id is not None:
+                sticky = [r for r in candidates if r.replica_id == sticky_id]
+                if sticky:
+                    # the session's pages live there — try it first even
+                    # if busier; a refusal falls back to least-loaded
+                    candidates = sticky + [r for r in candidates
+                                           if r.replica_id != sticky_id]
+        for rep in candidates:
             if rep.submit(req):
+                affine = req.session is not None \
+                    and rep.replica_id == sticky_id
+                if affine:
+                    self.counters.affinity_routed += 1
+                if req.session is not None:
+                    self._affinity[req.session] = rep.replica_id
                 self._instant("fleet/route", rid=req.rid, lane="decode",
-                              replica=rep.replica_id)
+                              replica=rep.replica_id, affine=affine)
                 self.counters.routed += 1
                 return None
         self.counters.shed_saturated += 1
@@ -188,6 +217,17 @@ class FleetRouter:
             self.counters.requeued += len(salvaged)
             self._results.extend(final)
             self._retry.extend(salvaged)
+            # the rebuilt replica's prefix store lost nothing, but any
+            # in-flight pins died with the old loop; sessions stamped to
+            # it must re-route freely (their next turn re-stamps)
+            stale = [k for k, v in self._affinity.items()
+                     if v == rep.replica_id]
+            for k in stale:
+                del self._affinity[k]
+                self.counters.affinity_invalidated += 1
+            if stale:
+                self._instant("fleet/affinity_invalidated",
+                              replica=rep.replica_id, sessions=len(stale))
         if self._tracer is not None:
             self._tracer.counter("fleet/heals", self.counters.heals,
                                  replica=rep.replica_id)

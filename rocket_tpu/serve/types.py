@@ -56,7 +56,11 @@ class Request:
     output below the batcher's buffer room (``None`` = fill the buffer);
     ``beam=True`` asks for the beam lane (honored at degradation level 0
     when the loop has a ``beam_fn``; demoted to the greedy continuous
-    lane otherwise — the result records the demotion).
+    lane otherwise — the result records the demotion).  ``session`` is an
+    opaque affinity key: the fleet router keeps turns of one session on
+    the replica whose prefix-cache store holds their KV pages (falling
+    back to least-loaded, and dropping the stamp when that replica is
+    healed).
     """
 
     rid: Any
@@ -64,6 +68,7 @@ class Request:
     deadline: Optional[float] = None
     max_new_tokens: Optional[int] = None
     beam: bool = False
+    session: Optional[Any] = None
 
     def __post_init__(self) -> None:
         prompt = np.asarray(self.prompt, np.int32)

@@ -62,6 +62,33 @@ def pytest_configure(config):
         "(rocket_tpu.observe.ledger|export; see docs/observability.md "
         "\"Goodput & metrics export\")",
     )
+    config.addinivalue_line(
+        "markers",
+        "kvcache: prefix-cache tier tests (rocket_tpu.serve.kvstore — "
+        "page hashing, LRU eviction, cached-prefix bit-equality, session "
+        "affinity; see docs/performance.md \"Prefix cache\")",
+    )
+
+
+# Fast-first ordering: the handful of files below carry the long
+# compile-heavy tails (full-model forwards, pipeline schedules, real
+# subprocess probes).  Running them LAST means the budgeted tier-1
+# sweep fails fast on the broad cheap coverage, and on a slow shared
+# host a timeout truncates into the heavy tail instead of silently
+# dropping whole subsystems.  Stable sort — relative order inside each
+# group is unchanged, and an untimed run still executes everything.
+_HEAVY_TAIL = (
+    "test_models.py",
+    "test_pipeline_parallel.py",
+    "test_checkpoint.py",
+    "test_tune.py",
+    "test_multi_optimizer.py",
+    "test_ladder_shapes.py",
+)
+
+
+def pytest_collection_modifyitems(config, items):
+    items.sort(key=lambda item: item.fspath.basename in _HEAVY_TAIL)
 
 
 @pytest.fixture(scope="session")

@@ -38,6 +38,7 @@ from rocket_tpu.serve import (
     HealthState,
     Overloaded,
     PrefillReplica,
+    PrefixKVStore,
     Replica,
     Request,
     ServingLoop,
@@ -554,4 +555,115 @@ class TestHealRaces:
         out = router.run_until_idle()
         _assert_exactly_once(out, [2])
         assert isinstance(out[0], Completed)
+        router.close()
+
+
+# -- session affinity over per-replica prefix stores (ISSUE 11) ----------
+
+
+@pytest.mark.kvcache
+class TestSessionAffinity:
+    """Requests carrying a ``session`` key stick to the replica whose
+    prefix store holds their pages; the cached turn decodes bit-equal
+    to the oracle; a heal invalidates the stamp and the session
+    re-routes cleanly with every request still typed exactly once."""
+
+    PAGE = 4
+
+    def _fleet(self, models, kill_r0_on=None, **bat_kw):
+        stores = [PrefixKVStore(page_tokens=self.PAGE,
+                                capacity_bytes=1 << 30) for _ in range(2)]
+        base = _bat_factory(models, **bat_kw)
+        built = {"r0": 0}
+
+        def factory(i):
+            def make():
+                loop = ServingLoop(base, max_batch=B, queue_capacity=16,
+                                   kvstore=stores[i])
+                if i == 0 and kill_r0_on is not None:
+                    built["r0"] += 1
+                    if built["r0"] == 1:
+                        return ReplicaKillInjector(loop,
+                                                   kill_on=kill_r0_on)
+                return loop
+            return make
+
+        reps = [Replica(factory(i), f"r{i}") for i in range(2)]
+        return FleetRouter(reps), reps, stores
+
+    def _turn(self, prompts, t):
+        # turn t of the session: the first page is shared, the tail is
+        # per-turn — the multi-turn shape at CPU-proxy size
+        p = prompts[0].copy()
+        p[self.PAGE:] = prompts[t][self.PAGE:]
+        return p
+
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_sticky_turn_hits_cache_bit_equal(self, models, prompts, int8):
+        kw = {"kv_cache_int8": True} if int8 else {}
+        router, reps, stores = self._fleet(models, **kw)
+        p1, p2 = self._turn(prompts, 1), self._turn(prompts, 2)
+
+        assert router.submit(Request(rid="t1", prompt=p1,
+                                     session="s")) is None
+        out1 = router.run_until_idle()
+        _assert_exactly_once(out1, ["t1"])
+        holder = out1[0].meta["replica"]
+        assert router._affinity["s"] == holder
+
+        # load the sticky replica so least-loaded WOULD pick the other:
+        # affinity must override the load tiebreak, not ride it
+        idx = int(holder[1])
+        assert router.submit(Request(rid="fill", prompt=prompts[5])) is None
+        if reps[idx].load == 0:
+            reps[1 - idx].loop.submit(Request(rid="x", prompt=prompts[6]))
+        assert router.submit(Request(rid="t2", prompt=p2,
+                                     session="s")) is None
+        assert router.counters.affinity_routed == 1
+        out = router.run_until_idle()
+        t2 = [r for r in out if r.rid == "t2"][0]
+        assert isinstance(t2, Completed)
+        assert t2.meta["replica"] == holder
+        # the sticky replica really served turn 2 from its pages...
+        snap = reps[idx].loop.counters.snapshot()
+        assert snap["kv_hits"] >= 1
+        assert stores[idx].snapshot()["hits"] >= 1
+        assert stores[idx].snapshot()["pinned"] == 0
+        # ...and the cached decode is bit-equal to the oracle
+        assert np.array_equal(t2.tokens, _oracle(models, p2))
+        router.close()
+
+    def test_heal_invalidates_affinity_rerouted_exactly_once(
+            self, models, prompts):
+        router, reps, stores = self._fleet(models, kill_r0_on=(1,))
+        p1, p2, p3 = (self._turn(prompts, t) for t in (1, 2, 3))
+
+        assert router.submit(Request(rid="t1", prompt=p1,
+                                     session="s")) is None
+        out1 = router.run_until_idle()
+        _assert_exactly_once(out1, ["t1"])
+        assert out1[0].meta["replica"] == "r0"   # idle tie -> r0, stamped
+
+        # turn 2 sticks to r0, which dies mid-round; the heal salvages
+        # it, drops the stamp, and the re-route still types it once
+        assert router.submit(Request(rid="t2", prompt=p2,
+                                     session="s")) is None
+        out2 = router.run_until_idle()
+        _assert_exactly_once(out2, ["t2"])
+        assert isinstance(out2[0], Completed)
+        assert np.array_equal(out2[0].tokens, _oracle(models, p2))
+        assert router.counters.heals == 1
+        assert router.counters.affinity_invalidated >= 1
+        # the rebuilt replica's store survived, with no leaked pins
+        assert stores[0].snapshot()["pinned"] == 0
+
+        # turn 3 routes cleanly on the fresh stamp (wherever the
+        # salvaged turn 2 landed) and completes bit-correct
+        assert router.submit(Request(rid="t3", prompt=p3,
+                                     session="s")) is None
+        out3 = router.run_until_idle()
+        _assert_exactly_once(out3, ["t3"])
+        assert isinstance(out3[0], Completed)
+        assert np.array_equal(out3[0].tokens, _oracle(models, p3))
+        assert router._affinity["s"] == out3[0].meta["replica"]
         router.close()

@@ -1,0 +1,437 @@
+"""Prefix-cache tier tests — rocket_tpu.serve.kvstore end to end.
+
+Four layers:
+
+- units: the rolling page-hash chain (determinism, prefix extension,
+  granularity separation), KVHandoff.split_pages / from_pages for the
+  f32 AND rank-4 int8-scale layouts with per-page nbytes accounting;
+- eviction edges (ISSUE 11 satellite): byte-budget boundary (evict
+  exactly enough to fit, never more), pinned in-flight pages never
+  evicted, LRU leaf-first ordering, oversized/unfittable inserts
+  rejected with occupancy intact, layout-signature mismatch loud;
+- the acceptance oracle: greedy decode from a cached prefix is
+  BIT-EQUAL to decode after a full prefill, f32 and int8, both at the
+  batcher layer (prefill_from_pages) and through a ServingLoop with the
+  store armed (the fleet session-affinity hop lives in test_fleet.py);
+- the export source: rocket_tpu_serve_kvstore_* gauges aggregate
+  across stores with hit_rate recomputed, not summed.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from rocket_tpu.models.generate import ContinuousBatcher, KVHandoff
+from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+from rocket_tpu.serve import Completed, Request, ServingLoop
+from rocket_tpu.serve.kvstore import (
+    PrefixKVStore,
+    page_hashes,
+    register_kvstore_source,
+)
+
+pytestmark = pytest.mark.kvcache
+
+B, P, TOTAL, NDRAFT, PAGE = 3, 12, 24, 4, 4
+
+
+def _lm(seed=1, **kw):
+    cfg = TransformerConfig(
+        vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=64, **kw
+    )
+    m = TransformerLM(cfg)
+    p = m.init(
+        jax.random.PRNGKey(seed),
+        {"tokens": np.zeros((1, P), np.int32),
+         "positions": np.zeros((1, P), np.int32)},
+    )["params"]
+    return m, p
+
+
+def _models(int8=False):
+    kw = {"kv_cache_int8": True} if int8 else {}
+    model, params = _lm(seed=1, **kw)
+    draft, _ = _lm(seed=1, **kw)
+    _, dparams = _lm(seed=7, **kw)
+    return model, draft, params, dparams
+
+
+def _bat(models, **kw):
+    model, draft, params, dparams = models
+    return ContinuousBatcher(model, draft, params, dparams,
+                             total_len=TOTAL, n_draft=NDRAFT,
+                             eos_token=None, **kw)
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(13)
+    return rng.integers(1, 64, size=(8, P)).astype(np.int32)
+
+
+def _tree_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        if not np.array_equal(np.asarray(x), np.asarray(y)):
+            return False
+    return True
+
+
+# -- units: the rolling hash chain ---------------------------------------
+
+
+class TestPageHashes:
+    def test_deterministic_and_prefix_extending(self):
+        toks = np.arange(1, 17, dtype=np.int32)
+        h1 = page_hashes(toks, PAGE)
+        h2 = page_hashes(toks, PAGE)
+        assert h1 == h2 and len(h1) == 4
+        # the chain over a longer sequence EXTENDS the shorter one's —
+        # this is what makes a cached chain reusable by a longer prompt
+        assert page_hashes(toks[:8], PAGE) == h1[:2]
+
+    def test_digest_commits_to_whole_prefix(self):
+        a = np.arange(1, 17, dtype=np.int32)
+        b = a.copy()
+        b[0] = 63              # differ only in page 0
+        ha, hb = page_hashes(a, PAGE), page_hashes(b, PAGE)
+        # every digest after the divergence differs, even though pages
+        # 1..3 hold identical tokens: the chain is content-addressed on
+        # the ENTIRE prefix, not the page alone
+        assert all(x != y for x, y in zip(ha, hb))
+
+    def test_granularities_never_collide(self):
+        toks = np.arange(1, 17, dtype=np.int32)
+        assert not set(page_hashes(toks, 4)) & set(page_hashes(toks, 8))
+
+    def test_limit_and_tail_remainder(self):
+        toks = np.arange(1, 17, dtype=np.int32)
+        assert len(page_hashes(toks, PAGE, limit=15)) == 3
+        assert len(page_hashes(toks[:14], PAGE)) == 3  # tail never hashes
+        assert len(page_hashes(toks[:3], PAGE)) == 0
+
+
+# -- units: paging the handoff -------------------------------------------
+
+
+class TestSplitJoinPages:
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_split_pages_layouts_and_nbytes(self, prompts, int8):
+        models = _models(int8)
+        h = _bat(models).prefill_handoff(prompts[0]).to_host()
+        n_tok = int(np.asarray(h.n_tok)[0])
+        pages = h.split_pages(PAGE)
+        assert len(pages) == (n_tok - 1) // PAGE
+        buf = np.asarray(h.buf)[0]
+        for i, page in enumerate(pages):
+            assert page.page_tokens == PAGE
+            assert np.array_equal(page.tokens, buf[i * PAGE:(i + 1) * PAGE])
+            assert page.nbytes > 0
+        # per-page accounting sums below the whole row (pages carry only
+        # their slots' KV, the handoff the full buffer)
+        assert sum(p.nbytes for p in pages) <= h.nbytes
+        leaves = jax.tree_util.tree_leaves(pages[0].cache_t)
+        if int8:
+            assert any(a.ndim == 4 and a.dtype == np.int8 for a in leaves)
+            assert any(a.ndim == 4 and a.dtype == np.float32
+                       for a in leaves)   # the rank-4 per-slot scales
+        else:
+            assert all(a.dtype != np.int8 for a in leaves)
+
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_from_pages_rebuilds_covered_prefix(self, prompts, int8):
+        models = _models(int8)
+        bat = _bat(models)
+        h = bat.prefill_handoff(prompts[0]).to_host()
+        pages = h.split_pages(PAGE)
+        slots = int(models[0].config.max_seq)
+        re = KVHandoff.from_pages(pages, total_len=TOTAL,
+                                  slots_t=slots, slots_d=slots)
+        covered = len(pages) * PAGE
+        assert int(np.asarray(re.n_tok)[0]) == covered
+        assert np.array_equal(np.asarray(re.buf)[0, :covered],
+                              np.asarray(h.buf)[0, :covered])
+        # KV slots inside the covered prefix are bit-equal to the full
+        # prefill's; beyond it they are zero (== fresh-prefill tail)
+        for full, reb in ((h.cache_t, re.cache_t), (h.cache_d, re.cache_d)):
+            for a, b in zip(jax.tree_util.tree_leaves(full),
+                            jax.tree_util.tree_leaves(reb)):
+                a, b = np.asarray(a), np.asarray(b)
+                if a.ndim != 4:
+                    continue
+                assert np.array_equal(a[:, :covered], b[:, :covered])
+                assert not np.any(b[:, covered:])
+
+    def test_from_pages_validates(self, prompts):
+        models = _models()
+        pages = _bat(models).prefill_handoff(prompts[0]) \
+            .to_host().split_pages(PAGE)
+        with pytest.raises(ValueError):
+            KVHandoff.from_pages([], total_len=TOTAL,
+                                 slots_t=64, slots_d=64)
+        with pytest.raises(ValueError):
+            # covered prefix + the to-be-recomputed final position must
+            # fit the buffer
+            KVHandoff.from_pages(pages, total_len=len(pages) * PAGE,
+                                 slots_t=64, slots_d=64)
+
+
+# -- eviction edges ------------------------------------------------------
+
+
+def _store_with_chain(prompts, *, pages_fit, extra_bytes=0, **kw):
+    """A store whose budget fits exactly ``pages_fit`` of the uniform
+    pages split from prompts[0]'s finished row."""
+    models = _models()
+    h = _bat(models).prefill_handoff(prompts[0]).to_host()
+    pages = h.split_pages(PAGE)
+    per = pages[0].nbytes
+    assert all(p.nbytes == per for p in pages)
+    store = PrefixKVStore(page_tokens=PAGE,
+                          capacity_bytes=per * pages_fit + extra_bytes,
+                          **kw)
+    return store, h, pages, per
+
+
+class TestEvictionEdges:
+    def test_budget_boundary_evicts_exactly_to_fit(self, prompts):
+        # the prefill handoff's reusable prefix is P = 12 tokens -> a
+        # 3-page chain; the budget fits exactly those 3 pages
+        store, h, pages, per = _store_with_chain(prompts, pages_fit=3)
+        assert store.insert(h) == 3
+        assert store.occupancy_bytes == 3 * per
+        # a foreign single-page chain displaces exactly ONE LRU page
+        other = page_hashes(np.full(PAGE, 63, np.int32), PAGE)
+        assert store.put_pages(other, pages[:1]) == 1
+        snap = store.snapshot()
+        assert snap["evictions"] == 1
+        assert snap["occupancy_bytes"] == 3 * per
+        assert snap["occupancy_bytes"] <= snap["capacity_bytes"]
+
+    def test_lru_is_leaf_first(self, prompts):
+        store, h, pages, per = _store_with_chain(prompts, pages_fit=3)
+        store.insert(h)
+        chain = page_hashes(np.asarray(h.buf)[0], PAGE,
+                            limit=int(np.asarray(h.n_tok)[0]) - 1)
+        other = page_hashes(np.full(PAGE, 63, np.int32), PAGE)
+        store.put_pages(other, pages[:1])
+        # the DEEPEST page of the cold chain went, the shared root stayed
+        assert chain[-1] not in store._table
+        assert chain[0] in store._table
+
+    def test_pinned_pages_never_evict(self, prompts):
+        store, h, pages, per = _store_with_chain(prompts, pages_fit=3)
+        store.insert(h)
+        match = store.lookup(np.asarray(h.buf)[0, :P])  # pins pages 0, 1
+        assert match is not None and len(match.hashes) == 2
+        # only page 2 is evictable: a 4-page foreign chain stores its
+        # first page (displacing page 2), then stops — the pins (and the
+        # chain's own just-stored page) block everything further
+        foreign = page_hashes(np.full(4 * PAGE, 63, np.int32), PAGE)
+        stored = store.put_pages(foreign, pages[:4])
+        assert stored == 1
+        snap = store.snapshot()
+        assert snap["rejected"] == 1
+        assert snap["occupancy_bytes"] <= snap["capacity_bytes"]
+        for hsh in match.hashes:           # the pinned pages survived
+            assert hsh in store._table
+        assert foreign[0] in store._table  # never self-evicted (no holes)
+        store.release(match)
+        # released pins are evictable again: the rejected pages now fit
+        assert store.put_pages(foreign, pages[:4]) == 2
+        snap = store.snapshot()
+        assert snap["occupancy_bytes"] <= snap["capacity_bytes"]
+
+    def test_unpin_all_stops_leaks(self, prompts):
+        store, h, pages, per = _store_with_chain(prompts, pages_fit=3)
+        store.insert(h)
+        assert store.lookup(np.asarray(h.buf)[0, :P]) is not None
+        assert store.snapshot()["pinned"] == 2
+        store.unpin_all()                  # the heal path's leak stopper
+        assert store.snapshot()["pinned"] == 0
+
+    def test_oversized_page_rejected_whole_chain_stops(self, prompts):
+        store, h, pages, per = _store_with_chain(prompts, pages_fit=0,
+                                                 extra_bytes=1)
+        assert store.insert(h) == 0        # nothing fits
+        snap = store.snapshot()
+        assert snap["rejected"] == 1 and snap["pages"] == 0
+        assert snap["occupancy_bytes"] == 0
+
+    def test_layout_mismatch_is_loud(self, prompts):
+        store, h, pages, per = _store_with_chain(prompts, pages_fit=8)
+        store.insert(h)
+        h8 = _bat(_models(int8=True)).prefill_handoff(prompts[1])
+        with pytest.raises(ValueError, match="layout"):
+            store.insert(h8)
+
+    def test_dedup_across_requests(self, prompts):
+        store, h, pages, per = _store_with_chain(prompts, pages_fit=8)
+        first = store.insert(h)
+        assert first == len(pages)
+        assert store.insert(h) == 0        # identical prefix: all dedup
+        assert store.snapshot()["dedup_hits"] == len(pages)
+
+
+# -- the acceptance oracle -----------------------------------------------
+
+
+class TestCachedPrefixOracle:
+    @pytest.mark.parametrize("int8", [False, True])
+    def test_cached_prefix_bit_equal_to_full_prefill(self, prompts, int8):
+        """Greedy decode from a cached prefix is bit-equal to decode
+        after a full prefill — handoff state AND every token to
+        completion, f32 and int8 KV layouts (acceptance oracle)."""
+        models = _models(int8)
+        pre = _bat(models)
+        h_full = pre.prefill_handoff(prompts[0][None, :])
+        store = PrefixKVStore(page_tokens=PAGE, capacity_bytes=1 << 30)
+        store.insert(h_full.to_host())
+        match = store.lookup(prompts[0])
+        assert match is not None
+        # lookup caps at len - 1: the final position's logits must be
+        # recomputed to sample the first new token
+        assert match.tokens == (P - 1) // PAGE * PAGE
+        h_cached = pre.prefill_from_pages(prompts[0][None, :], match.pages)
+        store.release(match)
+        for field in ("buf", "n_tok", "done", "cache_t", "cache_d"):
+            assert _tree_equal(getattr(h_full, field),
+                               getattr(h_cached, field)), field
+
+        def decode(h):
+            dec = _bat(models)
+            dec.start(jnp.asarray(prompts[:B], jnp.int32))
+            for r in range(B):
+                dec.retire(r)
+            dec.admit_prefilled(0, h)
+            while not bool(np.asarray(dec.state[2])[0]):
+                dec.step()
+            return dec.row_tokens(0)
+
+        tok_full, n_full = decode(h_full)
+        tok_cached, n_cached = decode(h_cached)
+        assert n_full == n_cached
+        assert np.array_equal(tok_full, tok_cached)
+
+    def test_partial_prefix_match_longest_wins(self, prompts):
+        models = _models()
+        bat = _bat(models)
+        store = PrefixKVStore(page_tokens=PAGE, capacity_bytes=1 << 30)
+        store.insert(bat.prefill_handoff(prompts[0]).to_host())
+        # a prompt sharing only the first page matches exactly one page
+        mixed = prompts[0].copy()
+        mixed[PAGE:] = prompts[1][PAGE:]
+        match = store.lookup(mixed)
+        assert match is not None and match.tokens == PAGE
+        h_cached = bat.prefill_from_pages(mixed[None, :], match.pages)
+        store.release(match)
+        h_full = bat.prefill_handoff(mixed[None, :])
+        assert _tree_equal(h_full.cache_t, h_cached.cache_t)
+        assert _tree_equal(h_full.buf, h_cached.buf)
+
+    def test_suffix_prefill_guards(self, prompts):
+        models = _models()
+        bat = _bat(models)
+        h = bat.prefill_handoff(prompts[0]).to_host()
+        pages = h.split_pages(PAGE)
+        with pytest.raises(ValueError, match="prefix"):
+            # hash-collision guard: pages must match the prompt tokens
+            bat.prefill_from_pages(prompts[1][None, :], pages[:2])
+
+    def test_rolling_cache_refused(self, prompts):
+        kw = dict(decode_rolling_cache=True, attention_window=16)
+        models = (_lm(seed=1, **kw)[0], _lm(seed=1, **kw)[0],
+                  _lm(seed=1, **kw)[1], _lm(seed=7, **kw)[1])
+        model, draft, params, dparams = models
+        bat = ContinuousBatcher(model, draft, params, dparams,
+                                total_len=TOTAL, n_draft=NDRAFT,
+                                eos_token=None)
+        assert not bat.prefix_cache_ok
+        h = _bat(_models()).prefill_handoff(prompts[0]).to_host()
+        with pytest.raises(ValueError, match="rolling"):
+            bat.prefill_from_pages(prompts[0][None, :],
+                                   h.split_pages(PAGE))
+
+
+# -- serving-loop integration --------------------------------------------
+
+
+class TestLoopIntegration:
+    def _factory(self, models):
+        def factory():
+            return _bat(models)
+        return factory
+
+    def test_hit_path_bit_equal_and_counted(self, prompts):
+        models = _models()
+        store = PrefixKVStore(page_tokens=PAGE, capacity_bytes=1 << 30)
+
+        def run(kv):
+            loop = ServingLoop(self._factory(models), max_batch=B,
+                               queue_capacity=8, kvstore=kv)
+            loop.submit(Request("r", prompts[0]))
+            out = loop.run_until_idle()
+            snap = loop.counters.snapshot()
+            loop.close()
+            return out, snap
+
+        (cold,), _ = run(None)
+        (miss,), snap_miss = run(store)     # miss: full prefill + export
+        (hit,), snap_hit = run(store)       # hit: suffix prefill
+        assert isinstance(cold, Completed)
+        assert np.array_equal(cold.tokens, miss.tokens)
+        assert np.array_equal(cold.tokens, hit.tokens)
+        assert snap_miss["kv_hits"] == 0
+        assert snap_hit["kv_hits"] == 1
+        assert snap_hit["kv_hit_tokens"] == (P - 1) // PAGE * PAGE
+        assert store.snapshot()["pinned"] == 0   # released after import
+
+    def test_rolling_cache_loop_refused(self):
+        kw = dict(decode_rolling_cache=True, attention_window=16)
+        model, params = _lm(seed=1, **kw)
+        draft, _ = _lm(seed=1, **kw)
+        _, dparams = _lm(seed=7, **kw)
+
+        def factory():
+            return ContinuousBatcher(model, draft, params, dparams,
+                                     total_len=TOTAL, n_draft=NDRAFT,
+                                     eos_token=None)
+
+        store = PrefixKVStore(page_tokens=PAGE)
+        with pytest.raises(ValueError, match="rolling"):
+            ServingLoop(factory, max_batch=B, queue_capacity=8,
+                        kvstore=store)
+
+
+# -- the export source ---------------------------------------------------
+
+
+class TestExportSource:
+    def test_fleet_wide_gauges_recompute_hit_rate(self, prompts):
+        from rocket_tpu.observe.export import (
+            prometheus_text,
+            unregister_source,
+        )
+
+        models = _models()
+        h = _bat(models).prefill_handoff(prompts[0]).to_host()
+        a = PrefixKVStore(page_tokens=PAGE, capacity_bytes=1 << 30)
+        b = PrefixKVStore(page_tokens=PAGE, capacity_bytes=1 << 30)
+        a.insert(h)
+        m = a.lookup(prompts[0])            # a: 1 lookup, 1 hit
+        a.release(m)
+        b.lookup(prompts[1])                # b: 1 lookup, 0 hits
+        name = register_kvstore_source([a, b])
+        try:
+            text = prometheus_text()
+            assert "rocket_tpu_serve_kvstore_hits 1" in text
+            assert "rocket_tpu_serve_kvstore_lookups 2" in text
+            # recomputed from summed hits/lookups (0.5), NOT the summed
+            # per-store rates (1.0)
+            assert "rocket_tpu_serve_kvstore_hit_rate 0.5" in text
+        finally:
+            unregister_source(name)
