@@ -320,6 +320,15 @@ def run_config(name, module, batch_np, samples_per_step, n_steps, warmup,
         "mfu": round(mfu, 4) if mfu else None,
         "device": jax.devices()[0].device_kind,
     }
+    # Per-device memory plan from the sharding engine: what the rule-derived
+    # spec tree says each device holds at steady state (params / optimizer /
+    # total argument bytes).  This is the column TestZeroGuard asserts drops
+    # (N-1)/N when zero_stage=1 re-partitions the optimizer mirrors.
+    mem = module.memory_plan() if hasattr(module, "memory_plan") else None
+    if mem:
+        record["mem_param_mb"] = round(mem["param_bytes"] / 2**20, 1)
+        record["mem_opt_mb"] = round(mem["opt_bytes"] / 2**20, 1)
+        record["mem_total_mb"] = round(mem["total_bytes"] / 2**20, 1)
     if flops is None:
         record["flops_error"] = flops_err
     if mfu is not None and mfu > 1.0:

@@ -52,7 +52,11 @@ from rocket_tpu.engine.step import (
     build_window_step,
 )
 from rocket_tpu.observe.trace import span as trace_span
-from rocket_tpu.parallel.sharding import tree_shardings
+from rocket_tpu.parallel.sharding import (
+    DEFAULT_PARTITION_RULES,
+    specs_for_state,
+    tree_shardings,
+)
 
 
 def _as_adapter(model: Any) -> ModelAdapter:
@@ -387,7 +391,24 @@ class Module(Dispatcher):
         param_specs = self._adapter.partition_specs(
             abstract_state.params, runtime.rules
         )
-        shardings = state_shardings(mesh, abstract_state, param_specs)
+        # One coherent resolution for the whole TrainState (params, optax
+        # mirrors, mutable collections) from the runtime's PartitionRules
+        # table — the same table the checkpoint manifest stamps and
+        # check_reshard validates against.  zero_stage=1 re-partitions the
+        # optimizer state over the data axis (engine.step all-gathers the
+        # updated params inside the jitted step).
+        plan = specs_for_state(
+            mesh,
+            abstract_state,
+            rules=getattr(
+                runtime, "partition_rules", DEFAULT_PARTITION_RULES
+            ),
+            param_specs=param_specs,
+            zero_stage=getattr(runtime, "zero_stage", 0),
+        )
+        self._sharding_plan = plan
+        self._abstract_state = abstract_state
+        shardings = plan.state_shardings
 
         self._weights_override = None
         if self._pending_restore is not None:
@@ -458,6 +479,14 @@ class Module(Dispatcher):
         donate = donate and jax.default_backend() != "cpu"
         if self._tx is not None:
             if self._use_window:
+                plan = getattr(self, "_sharding_plan", None)
+                if plan is not None and plan.zero_stage >= 1:
+                    raise ValueError(
+                        "zero_stage=1 is not supported with "
+                        "fuse_accumulation — the fused window step applies "
+                        "the update outside the ZeRO shard domain; use "
+                        "micro/sync accumulation"
+                    )
                 if skip:
                     self._logger.warning(
                         "skip_nonfinite guard is not supported with "
@@ -482,6 +511,7 @@ class Module(Dispatcher):
                     gradient_accumulation_steps=self._accum,
                     donate=donate,
                     skip_nonfinite=skip,
+                    shard_plan=getattr(self, "_sharding_plan", None),
                 )
         self._eval_step = build_eval_step(
             self._adapter.apply_fn, self._objectives, policy=policy,
@@ -656,6 +686,25 @@ class Module(Dispatcher):
         from rocket_tpu.core.optimizer import find_params_ema
 
         return find_params_ema(self._state.opt_state)
+
+    @property
+    def sharding_plan(self):
+        """The :class:`~rocket_tpu.parallel.sharding.ShardingPlan` resolved
+        at materialization (None before)."""
+        return getattr(self, "_sharding_plan", None)
+
+    def memory_plan(self) -> Optional[dict]:
+        """Per-device byte accounting of the materialized state under its
+        sharding plan (``{'param_bytes', 'opt_bytes', 'other_bytes',
+        'total_bytes'}`` — see :func:`rocket_tpu.engine.state.memory_plan`).
+        None before materialization."""
+        plan = getattr(self, "_sharding_plan", None)
+        abstract = getattr(self, "_abstract_state", None)
+        if plan is None or abstract is None:
+            return None
+        from rocket_tpu.engine.state import memory_plan
+
+        return memory_plan(abstract, plan.state_specs, plan.mesh)
 
     def state_dict(self) -> Attributes:
         if self._state is None:

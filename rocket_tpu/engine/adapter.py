@@ -156,33 +156,31 @@ def state_shardings(
     mesh: Mesh,
     abstract_state: Any,
     param_specs: Any,
+    rules: Optional[Any] = None,
+    zero_stage: int = 0,
 ) -> Any:
     """NamedShardings for a full TrainState given the param PartitionSpecs.
 
-    ``opt_state``/``grad_accum`` leaves inherit the sharding of the param
-    they mirror (matched by tree-path suffix AND shape); everything else
-    (counters, rng, scalars) is replicated — the GSPMD analogue of accelerate
-    keeping optimizer state co-located with its params.
+    Thin wrapper over
+    :func:`rocket_tpu.parallel.sharding.specs_for_state` — optimizer-state
+    subtrees that structurally mirror the params (Adam mu/nu, Muon
+    momenta, EMA shadows, grad-accum) inherit the param specs
+    positionally; everything else resolves through the
+    :class:`~rocket_tpu.parallel.sharding.PartitionRules` path rules or
+    replicates.  (The old tree-path-*suffix* heuristic this replaces
+    silently took the first hit's spec when two params shared a suffix
+    and shape — see tests/test_sharding_rules.py for the regression.)
     """
-    abstract_params = abstract_state.params
-    flat_specs, _ = jax.tree_util.tree_flatten_with_path(param_specs)
-    flat_params, _ = jax.tree_util.tree_flatten_with_path(abstract_params)
-    # (path, shape) -> spec; paths are stringified key tuples.
-    param_table = {}
-    for (ppath, pleaf), (_, spec) in zip(flat_params, flat_specs):
-        key = tuple(str(p) for p in ppath)
-        param_table[key] = (getattr(pleaf, "shape", None), spec)
+    from rocket_tpu.parallel.sharding import (
+        DEFAULT_PARTITION_RULES,
+        specs_for_state,
+    )
 
-    def shard_for(path, leaf) -> NamedSharding:
-        shape = getattr(leaf, "shape", None)
-        key = tuple(str(p) for p in path)
-        for plen in range(len(key), 0, -1):
-            suffix = key[-plen:]
-            hit = param_table.get(suffix)
-            if hit is not None and hit[0] == shape:
-                return NamedSharding(mesh, hit[1])
-        return replicated(mesh)
-
-    flat_state, treedef = jax.tree_util.tree_flatten_with_path(abstract_state)
-    shardings = [shard_for(path, leaf) for path, leaf in flat_state]
-    return jax.tree_util.tree_unflatten(treedef, shardings)
+    plan = specs_for_state(
+        mesh,
+        abstract_state,
+        rules=rules if rules is not None else DEFAULT_PARTITION_RULES,
+        param_specs=param_specs,
+        zero_stage=zero_stage,
+    )
+    return plan.state_shardings

@@ -88,3 +88,53 @@ def abstract_state(
     """Shape/dtype skeleton of a state without allocating it — used to derive
     shardings before real (possibly distributed) initialization."""
     return jax.eval_shape(init_fn)
+
+
+def _leaf_device_bytes(leaf: Any, spec: Any, mesh: Any) -> int:
+    """Per-device bytes of one leaf under a PartitionSpec: each dim is
+    divided (ceil) by the product of its mesh-axis sizes."""
+    import math
+
+    shape = list(getattr(leaf, "shape", ()))
+    itemsize = jnp.dtype(getattr(leaf, "dtype", jnp.float32)).itemsize
+    axes = dict(mesh.shape)
+    entries = tuple(spec) if spec is not None else ()
+    for i, entry in enumerate(entries[: len(shape)]):
+        names = (
+            () if entry is None
+            else (entry,) if isinstance(entry, str) else tuple(entry)
+        )
+        divisor = int(math.prod([axes.get(str(n), 1) for n in names] or [1]))
+        shape[i] = -(-shape[i] // divisor)  # ceil
+    return int(math.prod(shape or [1])) * itemsize
+
+
+def memory_plan(abstract: TrainState, state_specs: TrainState, mesh: Any) -> dict:
+    """Per-device byte accounting of a TrainState under a spec tree.
+
+    Returns ``{'param_bytes', 'opt_bytes', 'other_bytes', 'total_bytes'}``
+    — what the sharding plan says each device holds at steady state
+    (arguments only; activations/temps are the compiler's side).  This is
+    the number the bench ladder reports and the ZeRO guard asserts on.
+    """
+    from jax.sharding import PartitionSpec
+
+    is_spec = lambda x: isinstance(x, PartitionSpec)
+
+    def section_bytes(tree: Any, specs: Any) -> int:
+        leaves = jax.tree_util.tree_leaves(tree)
+        spec_leaves = jax.tree_util.tree_leaves(specs, is_leaf=is_spec)
+        return sum(
+            _leaf_device_bytes(leaf, spec, mesh)
+            for leaf, spec in zip(leaves, spec_leaves)
+        )
+
+    param_bytes = section_bytes(abstract.params, state_specs.params)
+    opt_bytes = section_bytes(abstract.opt_state, state_specs.opt_state)
+    total_bytes = section_bytes(abstract, state_specs)
+    return {
+        "param_bytes": param_bytes,
+        "opt_bytes": opt_bytes,
+        "other_bytes": total_bytes - param_bytes - opt_bytes,
+        "total_bytes": total_bytes,
+    }

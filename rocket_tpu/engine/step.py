@@ -168,8 +168,23 @@ def build_train_step(
     log_grad_norm: bool = True,
     donate: Optional[bool] = True,
     skip_nonfinite: bool = False,
+    shard_plan: Optional[Any] = None,
 ) -> Dict[str, Callable[[TrainState, Any], Tuple[TrainState, Dict[str, Any]]]]:
     """Build the jitted training step(s).
+
+    ``shard_plan`` (a :class:`rocket_tpu.parallel.sharding.ShardingPlan`
+    with ``zero_stage >= 1``) turns on ZeRO-style cross-replica
+    weight-update sharding (arXiv 2004.13336) inside the step: gradients
+    are pinned to the params' sharding (so the backward subprogram stays
+    identical to the unsharded step), then sliced to the data-composed
+    shard domain; the optax update and the ``params + update`` add both
+    run on the shard; the updated params are all-gathered back to the
+    base domain; the new optimizer state stays on the shard.  The two
+    explicit pins around the apply-add keep XLA's mul+add FMA contraction
+    on-shard — exactly the grouping the unsharded step fuses — which is
+    what makes the trajectory bit-equal, not just numerically close.
+    With ``shard_plan=None`` (or ``zero_stage=0``) the step body is
+    byte-identical to the pre-ZeRO one.
 
     Returns ``{"sync": fn}`` when not accumulating, else
     ``{"sync": fn, "micro": fn}`` — the host calls ``micro`` for the first
@@ -210,6 +225,7 @@ def build_train_step(
     loss_fn = build_loss_fn(apply_fn, objectives, policy)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     n = gradient_accumulation_steps
+    zero = shard_plan is not None and getattr(shard_plan, "zero_stage", 0) >= 1
 
     def forward_backward(state: TrainState, batch: Any):
         rng = jax.random.fold_in(state.rng, state.step)
@@ -259,14 +275,42 @@ def build_train_step(
             logs["grad_norm"] = grad_norm
 
         def apply_update(grads):
+            if zero:
+                # Pin grads to the base param domain first (forces the
+                # backward to match the unsharded step bit-for-bit), then
+                # slice them — and the params — to the ZeRO shard.
+                grads = jax.lax.with_sharding_constraint(
+                    grads, shard_plan.param_shardings
+                )
+                grads = jax.lax.with_sharding_constraint(
+                    grads, shard_plan.zero_param_shardings
+                )
+                params_in = jax.lax.with_sharding_constraint(
+                    state.params, shard_plan.zero_param_shardings
+                )
+            else:
+                params_in = state.params
             updates, new_opt_state = tx.update(
-                grads, state.opt_state, state.params
+                grads, state.opt_state, params_in
             )
             if lr_scale is not None:
                 updates = jax.tree_util.tree_map(
                     lambda u: u * lr_scale, updates
                 )
-            new_params = optax.apply_updates(state.params, updates)
+            new_params = optax.apply_updates(params_in, updates)
+            if zero:
+                # The shard-domain pin BEFORE the gather keeps the
+                # params+update add (and its FMA contraction) on-shard;
+                # the second constraint is then a pure all-gather.
+                new_params = jax.lax.with_sharding_constraint(
+                    new_params, shard_plan.zero_param_shardings
+                )
+                new_params = jax.lax.with_sharding_constraint(
+                    new_params, shard_plan.param_shardings
+                )
+                new_opt_state = jax.lax.with_sharding_constraint(
+                    new_opt_state, shard_plan.opt_shardings
+                )
             return new_params, new_opt_state, state.step + 1, new_mutable
 
         if skip_nonfinite:

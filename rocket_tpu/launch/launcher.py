@@ -80,11 +80,13 @@ class Launcher(Dispatcher):
         logger: Optional[Any] = None,
         goodput: bool = True,
         metrics_port: Optional[int] = None,
+        zero_stage: int = 0,
     ) -> None:
         super().__init__(
             capsules=capsules, statefull=statefull, priority=priority, logger=logger
         )
         self._tag = tag
+        self._zero_stage = int(zero_stage)
         self._num_epochs = int(num_epochs)
         self._mesh = mesh
         self._mixed_precision = mixed_precision
@@ -140,6 +142,7 @@ class Launcher(Dispatcher):
             gradient_accumulation_steps=self._grad_accum,
             seed=self._seed,
             tracing=self._tracing,
+            zero_stage=self._zero_stage,
         )
         runtime.project_dir = self._resolve_project_dir()
         if runtime.project_dir is not None:

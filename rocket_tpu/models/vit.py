@@ -87,17 +87,31 @@ class ViT(nn.Module):
             strides=(cfg.patch_size, cfg.patch_size),
             padding="VALID",
             dtype=cdtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), (None, None, None, "embed")
+            ),
+            bias_init=nn.with_partitioning(
+                nn.initializers.zeros_init(), ("embed",)
+            ),
             name="patchify",
         )(x)
         x = x.reshape(B, -1, cfg.hidden)  # [B, patches, hidden]
         cls_token = self.param(
-            "cls", nn.initializers.zeros_init(), (1, 1, cfg.hidden)
+            "cls",
+            nn.with_partitioning(
+                nn.initializers.zeros_init(), (None, None, "embed")
+            ),
+            (1, 1, cfg.hidden),
         )
         cls_token = cls_token.astype(cdtype)
         x = jnp.concatenate([jnp.broadcast_to(cls_token, (B, 1, cfg.hidden)), x], 1)
         S = x.shape[1]
         pos = self.param(
-            "pos_embedding", nn.initializers.normal(0.02), (1, S, cfg.hidden)
+            "pos_embedding",
+            nn.with_partitioning(
+                nn.initializers.normal(0.02), (None, None, "embed")
+            ),
+            (1, S, cfg.hidden),
         )
         x = x + pos.astype(cdtype)
         if cfg.dropout and train:
@@ -112,7 +126,17 @@ class ViT(nn.Module):
             x, _ = block(x, positions, None, train)
 
         x = _Norm(enc, name="ln_f")(x)
-        logits = nn.Dense(cfg.num_classes, dtype=cdtype, name="head")(x[:, 0])
+        logits = nn.Dense(
+            cfg.num_classes,
+            dtype=cdtype,
+            kernel_init=nn.with_partitioning(
+                nn.initializers.lecun_normal(), ("embed", "vocab")
+            ),
+            bias_init=nn.with_partitioning(
+                nn.initializers.zeros_init(), ("vocab",)
+            ),
+            name="head",
+        )(x[:, 0])
         out = Attributes(batch)
         out[self.logits_key] = logits
         return out

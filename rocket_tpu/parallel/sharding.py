@@ -1,18 +1,79 @@
-"""Named-sharding helpers and logical-axis rules.
+"""Named-sharding helpers, logical-axis rules, and the rule-based engine
+that resolves one coherent placement for a full TrainState.
 
 This is where the reference's implicit "replicate the model, shard the batch"
 DDP contract (``rocket/core/module.py:106``, ``dataset.py:175-180``) becomes
-explicit, composable GSPMD shardings.  Models annotate parameters with
-*logical* axis names (``'embed'``, ``'mlp'``, ``'heads'``, …); a
-:class:`ShardingRules` table maps logical names to mesh axes, so the same
-model code runs replicated on one chip or tensor/fsdp-sharded on a pod —
-only the rules change.
+explicit, composable GSPMD shardings.  Two layers of naming:
+
+1. **Logical axes** — models annotate parameters with *logical* axis names
+   (``'embed'``, ``'mlp'``, ``'heads'``, …); a :class:`ShardingRules` table
+   maps logical names to mesh axes, so the same model code runs replicated
+   on one chip or tensor/fsdp-sharded on a pod — only the rules change.
+2. **Path rules** — :class:`PartitionRules` maps *leaf paths* (regexes over
+   ``'block_0/attn/q/kernel'``-style canonical paths) to logical-spec
+   tuples, so trees that carry **no** annotations — optax optimizer state,
+   grad-accum buffers, mutable collections, externally-defined models —
+   resolve through the same vocabulary.
+
+:func:`specs_for_state` combines both into a :class:`ShardingPlan`: the
+single source of truth consumed by ``core/module.py`` (materialization),
+the ``engine/step.py`` train step (ZeRO constraints), ``persist/integrity``
+(manifest stamps + ``check_reshard`` restore targets) and ``bench.py`` /
+``Module.memory_plan()`` (per-device byte accounting).  Optimizer-state
+subtrees that are *structural mirrors* of the params (Adam ``mu``/``nu``,
+Muon momenta, EMA shadows) inherit the param specs positionally — this
+retires the old path-suffix heuristic that silently mis-placed state when
+two params shared a suffix and shape.
+
+Rule semantics (each under test in ``tests/test_sharding_rules.py``):
+first-match-wins precedence; ``re.search`` so patterns anchor themselves
+(``$``, ``(^|/)`` — ``head/kernel`` must not match ``overhead/kernel``);
+scalar/size-1 leaves replicate before any rule is consulted; a rule names
+the *trailing* dims (right-aligned, so one ``("embed", "mlp")`` rule covers
+a rank-2 kernel and its scan-stacked rank-3 variant); a trailing ``/value``
+component (flax ``nn.Partitioned`` box) is stripped; an unmatched leaf
+raises :class:`UnmatchedLeafError` naming the exact path — never a silent
+replication.  :data:`DEFAULT_PARTITION_RULES` covers the whole model zoo
+(transformer incl. LoRA / int8 / fused-QKV / scan, MoE, ViT, ResNet,
+seq2seq, LeNet); a tier-1 lint asserts the regex-derived specs equal the
+annotation-derived specs leaf-for-leaf for every config.
+
+**ZeRO stage 1** (``Runtime(zero_stage=1)`` / ``Launcher(zero_stage=1)``,
+arXiv 2004.13336 "Automatic Cross-Replica Sharding of Weight Update in
+Data-Parallel Training"): optimizer state and the weight update
+re-partition over the ``data`` axis (:func:`zero_compose` folds ``data``
+into the first evenly-divisible dim, composing with — not replacing — any
+existing fsdp/tensor sharding); the optax update runs on the shard and
+only the updated params are all-gathered, all inside the jitted step.  The
+constraint chain in ``engine/step.py`` keeps the trajectory **bit-equal**
+to the unsharded path (Adam and Muon, ± EMA)::
+
+    grads      -> pin to base param shardings   # backward stays identical
+    grads      -> pin to zero shardings         # slice to the update shard
+    params_in  -> pin to zero shardings
+    tx.update + apply_updates                   # run entirely on the shard
+    new_params -> pin to zero shardings         # keep the FMA on-shard
+    new_params -> pin to base shardings         # the all-gather
+    new_opt    -> pin to zero opt shardings     # moments stay sharded
+
+Muon's rank-2 params are exempt (Newton-Schulz orthogonalization reduces
+over the full matrix); grad-accum buffers stay at base sharding (the
+micro-sum must be elementwise-exact); ``zero_stage=1`` is incompatible
+with ``fuse_accumulation`` windows.  At Llama-2-7B full-finetune with
+Adam on a pure 8-way ``data`` mesh this turns 25.1 GB of replicated
+moments into 3.1 GB per device — 40.3 GB of step arguments (provably over
+a 32 GB v4 chip) down to 15.7 GB (AOT-compiles within the envelope); the
+worked example lives in ``docs/performance.md`` and is pinned by
+``tests/test_ladder_shapes.py::test_llama2_7b_full_finetune_zero1_fits_v4_hbm``
+and ``tests/test_bench_guard.py::TestZeroGuard``.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional, Sequence, Tuple, Union
+import math
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
@@ -171,3 +232,418 @@ def shard_like(tree: Any, shardings: Any) -> Any:
     """Constrain/lay out every leaf of ``tree`` per ``shardings``
     (device_put for concrete arrays)."""
     return jax.device_put(tree, shardings)
+
+
+# ---------------------------------------------------------------------------
+# Rule engine: regex-over-leaf-path partition rules.
+#
+# The annotation path (``nn.with_partitioning`` -> ``ShardingRules``) covers
+# params the model author labelled; :class:`PartitionRules` covers everything
+# by *path* — params, optimizer mirrors, mutable collections — from one
+# ordered rule table, first match wins.  This is the single source the
+# trainer (``core.module``), the manifest stamp (``persist.integrity``) and
+# ``check_reshard`` all consume.
+# ---------------------------------------------------------------------------
+
+# A rule's logical spec names the TRAILING dims of the leaf (right-aligned);
+# leading dims pad with None.  One ('embed',) rule therefore covers the
+# rank-2 unrolled kernel AND its rank-3 scan-stacked twin.  ``None`` as the
+# whole spec means fully replicated.
+LogicalSpec = Optional[Tuple[Optional[str], ...]]
+
+
+def canonical_path(path: Any) -> str:
+    """'/'-joined leaf path, container-agnostic (mirrors
+    ``persist.integrity._canon_path``): dict keys, NamedTuple fields and
+    sequence indices all canonicalize to their bare names."""
+    parts = []
+    for key in path:
+        for attr in ("name", "key", "idx"):
+            value = getattr(key, attr, None)
+            if value is not None:
+                parts.append(str(value))
+                break
+        else:
+            parts.append(str(key))
+    return "/".join(parts)
+
+
+class UnmatchedLeafError(ValueError):
+    """A leaf no rule matches — names the exact leaf path."""
+
+
+def _leaf_size(shape: Sequence[int]) -> int:
+    return int(math.prod(tuple(shape))) if shape is not None else 1
+
+
+@dataclasses.dataclass(frozen=True)
+class PartitionRules:
+    """Ordered ``(regex, logical-spec)`` rules over '/'-joined leaf paths.
+
+    Matching is ``re.search`` with first-match-wins precedence — anchor with
+    ``$`` (and ``(^|/)`` where a bare name could be a substring of another).
+    Logical names resolve through ``axes`` (a :class:`ShardingRules` table),
+    so retargeting a whole rule set to a different mesh layout is
+    ``rules.with_axes(...)``, not a rewrite.
+
+    Scalar and size-1 leaves are forced replicated before any rule is
+    consulted; a leaf that no rule matches raises
+    :class:`UnmatchedLeafError` naming the exact path.
+    """
+
+    rules: Tuple[Tuple[str, LogicalSpec], ...]
+    axes: ShardingRules = dataclasses.field(default_factory=lambda: DEFAULT_RULES)
+
+    def match(self, path: str) -> Optional[Tuple[str, LogicalSpec]]:
+        """First ``(pattern, logical-spec)`` whose regex matches ``path``.
+
+        A trailing ``/value`` component (the ``flax.linen.Partitioned``
+        box around annotated params and their optimizer mirrors) is
+        stripped first so rules name the param, not the box."""
+        if path.endswith("/value"):
+            path = path[: -len("/value")]
+        for pattern, logical in self.rules:
+            if re.search(pattern, path):
+                return pattern, logical
+        return None
+
+    def spec_for(self, path: str, shape: Sequence[int]) -> PartitionSpec:
+        """Resolve one leaf: scalar/size-1 -> replicated; else first
+        matching rule, right-aligned onto the leaf's trailing dims."""
+        shape = tuple(shape)
+        if _leaf_size(shape) <= 1:
+            return P()
+        hit = self.match(path)
+        if hit is None:
+            raise UnmatchedLeafError(
+                f"no partition rule matches leaf '{path}' (shape {shape}); "
+                f"add a (regex, logical-spec) rule to PartitionRules"
+            )
+        pattern, logical = hit
+        if logical is None:
+            return P()
+        if len(logical) > len(shape):
+            raise ValueError(
+                f"leaf '{path}': rule {pattern!r} names {len(logical)} "
+                f"trailing dims but the array is rank {len(shape)} "
+                f"(shape {shape})"
+            )
+        resolved = self.axes.spec(*logical)
+        entries = [None] * (len(shape) - len(logical)) + list(resolved)
+        return P(*entries)
+
+    def specs_for_tree(self, tree: Any) -> Any:
+        """PartitionSpec pytree for a pytree of (abstract) arrays; raises
+        :class:`UnmatchedLeafError` on the first uncovered leaf."""
+        def resolve(path, leaf):
+            return self.spec_for(canonical_path(path), jax.numpy.shape(leaf))
+
+        return jax.tree_util.tree_map_with_path(resolve, tree)
+
+    def with_axes(self, axes: ShardingRules) -> "PartitionRules":
+        return dataclasses.replace(self, axes=axes)
+
+    # -- manifest round-trip ------------------------------------------------
+    def table(self) -> Dict[str, MeshAxes]:
+        """The logical-axis table (delegates to ``axes``) — keeps the legacy
+        manifest ``rules`` stamp format stable."""
+        return self.axes.table()
+
+    def to_table(self) -> List[List[Any]]:
+        """JSON-able ``[[pattern, logical-or-null], ...]`` (order preserved)."""
+        return [
+            [pattern, None if logical is None else list(logical)]
+            for pattern, logical in self.rules
+        ]
+
+    @classmethod
+    def from_table(
+        cls,
+        table: Sequence[Sequence[Any]],
+        axes: Optional[ShardingRules] = None,
+    ) -> "PartitionRules":
+        rules = tuple(
+            (str(pattern), None if logical is None else tuple(logical))
+            for pattern, logical in table
+        )
+        return cls(rules=rules, axes=axes if axes is not None else DEFAULT_RULES)
+
+    @classmethod
+    def from_manifest(cls, mesh_section: Dict[str, Any]) -> "PartitionRules":
+        """Rebuild from a manifest's mesh section (the inverse of the
+        ``persist.integrity`` stamp): ``partition_rules`` carries the regex
+        table, ``rules`` the logical-axis table."""
+        axes_table = mesh_section.get("rules")
+        axes = DEFAULT_RULES
+        if axes_table:
+            axes = ShardingRules(rules=tuple(
+                (name, tuple(ax) if isinstance(ax, list) else ax)
+                for name, ax in axes_table
+            ))
+        return cls.from_table(mesh_section["partition_rules"], axes=axes)
+
+
+# The default rule vocabulary covers every model-zoo family (transformer —
+# unrolled, scanned, fused-qkv, int8, LoRA —, vit, resnet, moe, seq2seq,
+# lenet) with no per-model spec tables; a tier-1 lint asserts these rules
+# reproduce the annotation-derived specs exactly.  Order matters: specific
+# sub-leaf rules (lora/bias/scale) come before their kernel's rule only
+# where patterns overlap; catch-alls for unannotated vision stacks go last.
+DEFAULT_PARTITION_RULES = PartitionRules(rules=(
+    # attention projections (matches attn/, self_attn/, cross_attn/)
+    (r"attn/(q|k|v|qkv)/(kernel|kernel_q)$", ("embed", "heads")),
+    (r"attn/(q|k|v|qkv)/(bias|kernel_scale)$", ("heads",)),
+    (r"attn/(q|k|v|qkv)/lora_a$", ("embed", None)),
+    (r"attn/(q|k|v|qkv)/lora_b$", (None, "heads")),
+    (r"attn/o/(kernel|kernel_q)$", ("heads", "embed")),
+    (r"attn/o/(bias|kernel_scale)$", ("embed",)),
+    (r"attn/o/lora_a$", ("heads", None)),
+    (r"attn/o/lora_b$", (None, "embed")),
+    # dense mlp
+    (r"mlp/(gate|up)/(kernel|kernel_q)$", ("embed", "mlp")),
+    (r"mlp/(gate|up)/(bias|kernel_scale)$", ("mlp",)),
+    (r"mlp/(gate|up)/lora_a$", ("embed", None)),
+    (r"mlp/(gate|up)/lora_b$", (None, "mlp")),
+    (r"mlp/down/(kernel|kernel_q)$", ("mlp", "embed")),
+    (r"mlp/down/(bias|kernel_scale)$", ("embed",)),
+    (r"mlp/down/lora_a$", ("mlp", None)),
+    (r"mlp/down/lora_b$", (None, "embed")),
+    # mixture-of-experts
+    (r"moe/router$", ("embed", "expert")),
+    (r"moe/w_up$", ("expert", "embed", "mlp")),
+    (r"moe/w_down$", ("expert", "mlp", "embed")),
+    (r"moe/b_up$", ("expert", "mlp")),
+    # embedding / unembedding
+    (r"embed/embedding(_q)?$", ("vocab", "embed")),
+    (r"embed/embedding_scale$", ("vocab",)),
+    (r"(^|/)head/(kernel|kernel_q)$", ("embed", "vocab")),
+    (r"(^|/)head/(bias|kernel_scale)$", ("vocab",)),
+    # learned positions / ViT patchify + cls (right-aligned 'embed' covers
+    # the rank-2 (S, D) table and the rank-3/4 (1, S, D) / (P, P, C, D))
+    (r"pos_embedding$", ("embed",)),
+    (r"(^|/)cls$", ("embed",)),
+    (r"patchify/(kernel|bias)$", ("embed",)),
+    # norms (RMSNorm scale is annotated 'norm'; LayerNorm bias is not)
+    (r"(RMSNorm_\d+|LayerNorm_\d+)/scale$", ("norm",)),
+    (r"LayerNorm_\d+/bias$", None),
+    # unannotated vision stacks (resnet/lenet) + plain flax defaults:
+    # replicated, matching their annotation-free partition specs
+    (r"(^|/)Conv_\d+/(kernel|bias)$", None),
+    (r"(^|/)BatchNorm_\d+/(scale|bias|mean|var)$", None),
+    (r"(^|/)Dense_\d+/(kernel|bias)$", None),
+))
+
+
+# ---------------------------------------------------------------------------
+# ZeRO stage 1 (arXiv 2004.13336): optimizer state + the weight update are
+# sharded across the data axis; the updated params are all-gathered inside
+# the step.  ``zero_compose`` folds the data axis into the first dim whose
+# size the combined factor divides, composing with (not replacing) whatever
+# fsdp/tensor spec the leaf already has.
+# ---------------------------------------------------------------------------
+
+
+def zero_compose(
+    spec: PartitionSpec,
+    shape: Sequence[int],
+    mesh: Mesh,
+    axis: str = "data",
+) -> PartitionSpec:
+    """Fold ``axis`` into ``spec`` on the first evenly-divisible dim.
+
+    Scalars/size-1 leaves, leaves already sharded over ``axis`` and meshes
+    where ``axis`` has size 1 pass through unchanged; a leaf no dim of
+    which divides stays at its base spec (still correct, just not
+    ZeRO-sharded — the step's constraints are then no-ops for it)."""
+    shape = tuple(shape)
+    if _leaf_size(shape) <= 1 or dict(mesh.shape).get(axis, 1) <= 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for i, entry in enumerate(entries):
+        names = (
+            () if entry is None
+            else (entry,) if isinstance(entry, str) else tuple(entry)
+        )
+        if axis in names:
+            return P(*entries)
+        factor = dict(mesh.shape)[axis] * int(
+            math.prod([dict(mesh.shape)[n] for n in names] or [1])
+        )
+        if shape[i] % factor == 0:
+            entries[i] = (axis,) if entry is None else tuple(names) + (axis,)
+            return P(*entries)
+    return P(*entries)
+
+
+@dataclasses.dataclass(frozen=True)
+class ShardingPlan:
+    """One coherent sharding resolution for a full TrainState.
+
+    ``state_specs``/``state_shardings`` mirror the TrainState structure;
+    ``param_specs`` is the base (non-ZeRO) param spec tree the forward/
+    backward runs under; ``zero_param_shardings`` is the data-composed
+    domain the optimizer update runs in when ``zero_stage >= 1`` (equal to
+    ``param_shardings`` otherwise)."""
+
+    mesh: Mesh
+    rules: PartitionRules
+    zero_stage: int
+    param_specs: Any
+    state_specs: Any
+    param_shardings: Any
+    zero_param_shardings: Any
+    state_shardings: Any
+
+    @property
+    def opt_shardings(self) -> Any:
+        return self.state_shardings.opt_state
+
+
+def _is_spec_leaf(x: Any) -> bool:
+    return isinstance(x, PartitionSpec)
+
+
+def _zero_exempt_mask(abstract_state: Any, params_flat: Any) -> List[bool]:
+    """Params whose updates are matrix-valued (Muon's Newton-Schulz runs
+    norm + matmuls over the FULL matrix) must keep their entire state
+    chain on the base sharding domain — slicing them over ``data`` would
+    regroup the NS reductions and break bit-equality.  Detected by the
+    presence of a MuonState anywhere in the optimizer state; Muon
+    orthogonalizes every rank-2 leaf it sees, so every rank-2 param is
+    exempt."""
+    try:
+        from rocket_tpu.engine.muon import MuonState
+    except Exception:  # pragma: no cover - muon is part of the tree
+        return [False] * len(params_flat)
+
+    found = False
+
+    def visit(node):
+        nonlocal found
+        if isinstance(node, MuonState):
+            found = True
+        return node
+
+    jax.tree_util.tree_map(
+        visit, abstract_state.opt_state,
+        is_leaf=lambda n: isinstance(n, MuonState),
+    )
+    if not found:
+        return [False] * len(params_flat)
+    return [
+        len(getattr(leaf, "shape", ())) == 2 for _, leaf in params_flat
+    ]
+
+
+def specs_for_state(
+    mesh: Mesh,
+    abstract_state: Any,
+    rules: PartitionRules = DEFAULT_PARTITION_RULES,
+    param_specs: Any = None,
+    zero_stage: int = 0,
+) -> ShardingPlan:
+    """Resolve shardings for every leaf of a TrainState from one rule table.
+
+    Optimizer-state subtrees that are *structural mirrors* of the params
+    (same treedef, same leaf shapes — Adam's mu/nu, Muon momenta, EMA
+    shadows, grad-accum buffers) inherit the param specs positionally;
+    non-mirror leaves fall back to scalar-replication, then the regex
+    rules on their canonical path, then replication.  With
+    ``zero_stage=1`` mirror leaves (minus matrix-update-exempt params) are
+    re-partitioned over the ``data`` axis via :func:`zero_compose`.
+
+    ``param_specs`` overrides rule-derived param specs (the Module passes
+    annotation-derived specs through here so existing models keep their
+    exact layouts); when ``None`` the rules must cover every param leaf or
+    :class:`UnmatchedLeafError` is raised naming the path.
+    """
+    params = abstract_state.params
+    if param_specs is None:
+        param_specs = rules.specs_for_tree(params)
+
+    params_flat, params_td = jax.tree_util.tree_flatten_with_path(params)
+    spec_leaves = jax.tree_util.tree_leaves(param_specs, is_leaf=_is_spec_leaf)
+    spec_leaves = [P() if s is None else s for s in spec_leaves]
+    if len(spec_leaves) != len(params_flat):
+        raise ValueError(
+            f"param_specs has {len(spec_leaves)} leaves for "
+            f"{len(params_flat)} params"
+        )
+    param_shapes = [tuple(getattr(leaf, "shape", ())) for _, leaf in params_flat]
+
+    exempt = _zero_exempt_mask(abstract_state, params_flat)
+    if zero_stage >= 1:
+        zero_leaves = [
+            spec if exempt[i] else zero_compose(spec, param_shapes[i], mesh)
+            for i, spec in enumerate(spec_leaves)
+        ]
+    else:
+        zero_leaves = list(spec_leaves)
+
+    param_spec_tree = jax.tree_util.tree_unflatten(params_td, spec_leaves)
+    mirror_spec_tree = jax.tree_util.tree_unflatten(params_td, zero_leaves)
+
+    def is_mirror(node: Any) -> bool:
+        try:
+            if jax.tree_util.tree_structure(node) != params_td:
+                return False
+        except Exception:
+            return False
+        leaves = jax.tree_util.tree_leaves(node)
+        return all(
+            tuple(getattr(leaf, "shape", ())) == shape
+            for leaf, shape in zip(leaves, param_shapes)
+        )
+
+    def fallback_spec(path, leaf) -> PartitionSpec:
+        shape = tuple(getattr(leaf, "shape", ()))
+        if _leaf_size(shape) <= 1:
+            return P()
+        hit = rules.match(canonical_path(path))
+        if hit is not None:
+            try:
+                return rules.spec_for(canonical_path(path), shape)
+            except ValueError:
+                return P()
+        return P()
+
+    def resolve_collection(tree: Any, mirror_specs: Any) -> Any:
+        """Spec tree for ``tree``: params-shaped subtrees take
+        ``mirror_specs`` wholesale; other leaves fall back per-path."""
+        if tree is None:
+            return None
+        flat, treedef = jax.tree_util.tree_flatten_with_path(
+            tree, is_leaf=lambda n: is_mirror(n)
+        )
+        out = []
+        for path, node in flat:
+            if is_mirror(node):
+                out.append(mirror_specs)
+            else:
+                out.append(fallback_spec(path, node))
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    state_specs = abstract_state.replace(
+        step=P(),
+        params=param_spec_tree,
+        opt_state=resolve_collection(abstract_state.opt_state, mirror_spec_tree),
+        rng=P(),
+        mutable=resolve_collection(abstract_state.mutable, param_spec_tree),
+        grad_accum=resolve_collection(abstract_state.grad_accum, param_spec_tree),
+        micro=None if abstract_state.micro is None else P(),
+    )
+
+    to_sharding = lambda spec: NamedSharding(mesh, spec)
+    as_shardings = lambda specs: jax.tree_util.tree_map(
+        to_sharding, specs, is_leaf=_is_spec_leaf
+    )
+    return ShardingPlan(
+        mesh=mesh,
+        rules=rules,
+        zero_stage=zero_stage,
+        param_specs=param_spec_tree,
+        state_specs=state_specs,
+        param_shardings=as_shardings(param_spec_tree),
+        zero_param_shardings=as_shardings(mirror_spec_tree),
+        state_shardings=as_shardings(state_specs),
+    )

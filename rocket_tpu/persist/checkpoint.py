@@ -296,7 +296,10 @@ class Checkpointer(Capsule):
                     iter_idx=self._iter_idx,
                     epoch_idx=self._epoch_idx,
                     mesh=self._runtime.mesh,
-                    rules=getattr(self._runtime, "rules", None),
+                    rules=(
+                        getattr(self._runtime, "partition_rules", None)
+                        or getattr(self._runtime, "rules", None)
+                    ),
                 )
         self._iter_idx += 1
 
@@ -363,7 +366,10 @@ class Checkpointer(Capsule):
         manifest = integrity.build_manifest(
             items, iter_idx=self._iter_idx, epoch_idx=self._epoch_idx,
             mesh=self._runtime.mesh,
-            rules=getattr(self._runtime, "rules", None),
+            rules=(
+                getattr(self._runtime, "partition_rules", None)
+                or getattr(self._runtime, "rules", None)
+            ),
         )
         # Prune BEFORE appending the new path, so retention counts only
         # already-issued saves: the newest tracked entry always exists on

@@ -129,6 +129,13 @@ def _mesh_section(mesh: Any, rules: Any) -> Optional[Dict[str, Any]]:
             [name, list(axes) if isinstance(axes, (tuple, list)) else axes]
             for name, axes in table.items()
         ]
+        # PartitionRules (the path-rule engine) additionally stamps its
+        # ordered regex table so a restoring process rebuilds the EXACT
+        # rule set the trainer resolved shardings from
+        # (PartitionRules.from_manifest is the inverse) — one definition
+        # site for the trainer and check_reshard.
+        if hasattr(rules, "to_table"):
+            section["partition_rules"] = rules.to_table()
     return section
 
 

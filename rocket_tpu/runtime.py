@@ -36,7 +36,9 @@ from rocket_tpu.engine.precision import Policy
 from rocket_tpu.parallel import multihost
 from rocket_tpu.parallel.mesh import DATA_AXES, MeshSpec, data_parallel_mesh
 from rocket_tpu.parallel.sharding import (
+    DEFAULT_PARTITION_RULES,
     DEFAULT_RULES,
+    PartitionRules,
     ShardingRules,
     batch_sharding,
     replicated,
@@ -54,6 +56,8 @@ class Runtime:
         tracing: bool = False,
         trace_capacity: int = 4096,
         donate_train_state: Optional[bool] = None,
+        partition_rules: Optional[PartitionRules] = None,
+        zero_stage: int = 0,
     ) -> None:
         if mesh is None:
             mesh = data_parallel_mesh()
@@ -69,6 +73,24 @@ class Runtime:
             raise ValueError("gradient_accumulation_steps must be >= 1")
         self.gradient_accumulation_steps = int(gradient_accumulation_steps)
         self.rules = rules
+        # Path-based rule engine (parallel.sharding.PartitionRules): the
+        # single table the trainer's state shardings, the manifest stamp
+        # and check_reshard all resolve from.  Defaults to the zoo-covering
+        # DEFAULT_PARTITION_RULES retargeted to this run's logical-axis
+        # table.
+        self.partition_rules = (
+            partition_rules
+            if partition_rules is not None
+            else DEFAULT_PARTITION_RULES.with_axes(rules)
+        )
+        # ZeRO stage (arXiv 2004.13336): 0 = replicated optimizer state,
+        # 1 = optimizer state + weight update sharded over the data axis
+        # (params all-gathered inside the step; bit-equal trajectory).
+        if zero_stage not in (0, 1):
+            raise ValueError(
+                f"zero_stage must be 0 or 1, got {zero_stage!r}"
+            )
+        self.zero_stage = int(zero_stage)
         self.seed = int(seed)
         # Host-side structured tracing (observe.trace): arming here turns
         # on the Dispatcher's per-capsule lifecycle spans, the serve loop's

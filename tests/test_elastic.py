@@ -49,7 +49,8 @@ def _mesh(n):
 
 
 def _tree(tmp_path, data, *, tag, epochs, mesh=None, extra=(),
-          save_every=100, emergency_every=None, resume=None, seed=0):
+          save_every=100, emergency_every=None, resume=None, seed=0,
+          zero_stage=0):
     """The chaos tree of test_resilience, parameterized by mesh: 256
     samples / batch 64 = 4 iterations per epoch on any device count."""
     model = rt.Module(
@@ -74,7 +75,7 @@ def _tree(tmp_path, data, *, tag, epochs, mesh=None, extra=(),
     )
     launcher = rt.Launcher(
         capsules=[looper], tag=tag, num_epochs=epochs, mesh=mesh,
-        project_root=str(tmp_path), seed=seed,
+        project_root=str(tmp_path), seed=seed, zero_stage=zero_stage,
     )
     if resume is not None:
         launcher.resume(resume)
@@ -530,3 +531,68 @@ def test_second_flush_without_new_capture_is_noop(tmp_path, devices):
     assert tier.flush("first") is not None
     assert tier.flush("second") is None  # nothing staged: idempotent
     assert tier.flushes == 1
+
+
+# -- ZeRO-1 snapshots across data-axis sizes ---------------------------------
+
+
+def test_zero1_snapshot_reshards_onto_larger_data_axis(tmp_path, devices):
+    """A ``zero_stage=1`` run preempted on a 4-way data axis resumes on
+    an 8-way axis: the restored optimizer mirrors must RE-PARTITION over
+    the new data axis (8-way, not 4-way, and certainly not replicated),
+    and the stitched trajectory still matches an uninterrupted unsharded
+    reference — ZeRO is a placement change, never a numerics change."""
+    import jax
+
+    data = synthetic_classification(n=256)
+
+    def _opt_mirror_specs(model):
+        """PartitionSpecs of the Dense_0 kernel's optimizer mirrors."""
+        out = []
+        for leaf in jax.tree_util.tree_leaves(model.state.opt_state):
+            if getattr(leaf, "shape", None) == (16, 32):
+                out.append(leaf.sharding.spec)
+        return out
+
+    launcher_a, model_a, rec_a = _tree(tmp_path, data, tag="zref", epochs=1)
+    launcher_a.launch()
+    assert len(rec_a.losses) == 4
+
+    # Stage 1: zero_stage=1 on 4 devices, preempted at iteration 2.
+    launcher_b, model_b, rec_b = _tree(
+        tmp_path, data, tag="zelastic", epochs=1, mesh=_mesh(4),
+        zero_stage=1, extra=[SigtermInjector(at_iter=2)],
+    )
+    launcher_b.launch()
+    assert len(rec_b.losses) == 3
+    specs_b = _opt_mirror_specs(model_b)
+    assert specs_b and all("data" in str(s) for s in specs_b), specs_b
+    snap = tmp_path / "zelastic" / "v0" / "weights" / "000002"
+    assert snap.is_dir()
+    assert integrity.manifest_mesh(str(snap))["axes"]["data"] == 4
+
+    # Stage 2: resume on all 8 devices, still zero_stage=1.
+    launcher_c, model_c, rec_c = _tree(
+        tmp_path, data, tag="zelastic", epochs=1, mesh=_mesh(8),
+        zero_stage=1, resume="auto",
+    )
+    launcher_c.launch()
+    assert len(rec_c.losses) == 1
+
+    specs_c = _opt_mirror_specs(model_c)
+    assert specs_c, "no optimizer mirrors found"
+    for spec in specs_c:
+        assert "data" in str(spec), (
+            f"restored optimizer mirror replicated ({spec}) — the reshard "
+            f"must re-partition over the new data axis"
+        )
+    # 8-way for real: each device holds 1/8 of the (16, 32) mirror
+    mirror = next(
+        leaf for leaf in jax.tree_util.tree_leaves(model_c.state.opt_state)
+        if getattr(leaf, "shape", None) == (16, 32)
+    )
+    shard_shapes = {s.data.shape for s in mirror.addressable_shards}
+    assert shard_shapes == {(2, 32)}, shard_shapes
+
+    stitched = rec_b.losses + rec_c.losses
+    np.testing.assert_allclose(stitched, rec_a.losses, rtol=1e-5, atol=1e-6)
