@@ -967,11 +967,177 @@ def bench_gpt2_decode(n_steps, warmup, overrides=None):
     }
 
 
+# -- pipeline schedule bench (ISSUE 13) ------------------------------------
+#
+# Record schema (config="pipeline", emitted by ``--only pipeline``):
+#   value / unit ........ interleaved (v=2) bubble reduction vs GPipe:
+#                         gpipe bubble_fraction / interleaved
+#                         bubble_fraction from the lockstep proxy run
+#   schedules.<name> .... one column set per schedule:
+#     bubble_fraction ... MEASURED: sum of the goodput ledger's
+#                         pipeline/bubble/stage<p> buckets over
+#                         (bubble + busy) seconds of the lockstep run —
+#                         the same buckets the fleet metrics export
+#     bubble_fraction_plan / ticks_forward / ticks_total / bubble_ticks /
+#     live_microbatches . analytic schedule_plan() columns
+#     stage_wait_s / stage_busy_s ... per-stage lockstep seconds
+#     mem_param_bytes / mem_opt_bytes / mem_other_bytes / mem_total_bytes
+#                         memory_plan() per-device TrainState bytes of the
+#                         pipelined proxy transformer under the
+#                         DEFAULT_PARTITION_RULES specs (PR 16 accounting)
+#     mem_live_activation_bytes ... live_microbatches x microbatch bytes
+#                         (the 1F1B residency bound made concrete)
+#   guard ............... "interleaved<gpipe: ok" or the failure text —
+#                         the bench-level form of the test-suite guard
+#
+# The lockstep driver exists because this proxy host is effectively
+# single-core: a threaded MPMD run measures OS-scheduler noise, while the
+# tick-round driver prices structural idleness at each stage's own
+# measured compute rate (see mpmd.run_lockstep).
+
+PIPELINE_PROXY = dict(n_stages=2, n_micro=8, n_layers=8, width=128,
+                      micro_batch=32)
+
+
+def measure_pipeline_schedules(n_stages=None, n_micro=None, n_layers=None,
+                               width=None, micro_batch=None,
+                               schedules=(("gpipe", 1), ("1f1b", 1),
+                                          ("interleaved", 2))):
+    """Lockstep-run each schedule on the CPU proxy stack; bubble fractions
+    are read back from the goodput ledger's per-stage buckets."""
+    import jax.numpy as jnp
+
+    from rocket_tpu.observe.ledger import get_goodput
+    from rocket_tpu.parallel import mpmd
+
+    P = n_stages or PIPELINE_PROXY["n_stages"]
+    M = n_micro or PIPELINE_PROXY["n_micro"]
+    L = n_layers or PIPELINE_PROXY["n_layers"]
+    D = width or PIPELINE_PROXY["width"]
+    B = micro_batch or PIPELINE_PROXY["micro_batch"]
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    params = {"w": jax.random.normal(ks[0], (L, D, D)) * 0.3,
+              "b": jax.random.normal(ks[1], (L, D)) * 0.01}
+    micros = jax.random.normal(ks[2], (M, B, D))
+    target = jax.random.normal(ks[3], (B, D))
+
+    def layer(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(y):
+        return jnp.mean((y - target) ** 2)
+
+    gp = get_goodput()
+    was_armed = gp.armed
+    out = {}
+    try:
+        for sched, v in schedules:
+            gp.start_run()
+            res = mpmd.run_lockstep(layer, params, micros, loss_fn,
+                                    n_stages=P, schedule=sched, n_chunks=v)
+            gp.end_run()
+            snap = gp.snapshot()
+            wait = [snap.get(f"pipeline/bubble/stage{p}_s", 0.0)
+                    for p in range(P)]
+            busy = [r.busy_s for r in res.reports]
+            denom = sum(wait) + sum(busy)
+            out[sched] = {
+                "n_chunks": v,
+                "bubble_fraction": round(sum(wait) / denom, 4) if denom
+                else 0.0,
+                "bubble_fraction_plan": round(
+                    res.plan["bubble_fraction"], 4),
+                "ticks_forward": res.plan["ticks_forward"],
+                "ticks_total": res.plan["ticks_total"],
+                "bubble_ticks": res.plan["bubble_ticks"],
+                "live_microbatches": res.plan["live_microbatches"],
+                "stage_wait_s": [round(w, 6) for w in wait],
+                "stage_busy_s": [round(b, 6) for b in busy],
+            }
+    finally:
+        gp.armed = was_armed
+    return out
+
+
+def _pipeline_memory_columns(schedule, n_chunks, n_stages=2, n_micro=4):
+    """memory_plan() per-device state bytes of a pipelined proxy
+    transformer + the schedule's live-activation bound."""
+    import optax
+
+    from rocket_tpu.engine.adapter import FlaxModel
+    from rocket_tpu.engine.state import TrainState, memory_plan
+    from rocket_tpu.parallel.mesh import MeshSpec
+    from rocket_tpu.parallel.pipeline import schedule_plan
+    from rocket_tpu.parallel.sharding import DEFAULT_RULES, specs_for_state
+
+    devs = jax.devices()
+    P = n_stages if len(devs) >= n_stages else 1
+    mesh = MeshSpec(pipe=P).build(devs[:P])
+    B, S, D = 8, 64, 128
+    cfg = TransformerConfig(
+        vocab_size=256, hidden=D, n_layers=8, n_heads=4, ffn_dim=256,
+        max_seq=S, attention="dot", pipeline_microbatches=n_micro,
+        pipeline_schedule=schedule, pipeline_chunks=n_chunks,
+    )
+    adapter = FlaxModel(TransformerLM(cfg))
+    adapter.configure(mesh, DEFAULT_RULES)
+    tx = optax.adamw(1e-4)
+
+    def init_fn():
+        import jax.numpy as jnp
+
+        batch = {"tokens": jnp.zeros((B, S), jnp.int32)}
+        params, mutable = adapter.init_variables(jax.random.PRNGKey(0), batch)
+        return TrainState.create(params, tx, mutable=mutable)
+
+    abstract = jax.eval_shape(init_fn)
+    param_specs = adapter.partition_specs(abstract.params, DEFAULT_RULES)
+    plan = specs_for_state(mesh, abstract, param_specs=param_specs)
+    mem = memory_plan(abstract, plan.state_specs, mesh)
+    micro_act_bytes = (B // n_micro) * S * D * 4
+    sched_plan = schedule_plan(schedule, P, n_micro, n_chunks,
+                               micro_act_bytes=micro_act_bytes)
+    return {
+        "mem_param_bytes": mem["param_bytes"],
+        "mem_opt_bytes": mem["opt_bytes"],
+        "mem_other_bytes": mem["other_bytes"],
+        "mem_total_bytes": mem["total_bytes"],
+        "mem_live_activation_bytes": sched_plan["live_activation_bytes"],
+    }
+
+
+def bench_pipeline(n_steps, warmup):
+    """Pipeline-schedule ladder record — see the schema comment above."""
+    measured = measure_pipeline_schedules()
+    for sched, cols in measured.items():
+        cols.update(_pipeline_memory_columns(sched, cols["n_chunks"]))
+    gp_b = measured["gpipe"]["bubble_fraction"]
+    il_b = measured["interleaved"]["bubble_fraction"]
+    guard = ("interleaved<gpipe: ok" if 0.0 < il_b < gp_b else
+             f"interleaved bubble {il_b} !< gpipe {gp_b}")
+    pp = PIPELINE_PROXY
+    return {
+        "config": "pipeline",
+        "metric": (f"pipeline schedule bubble (CPU lockstep proxy, "
+                   f"P={pp['n_stages']}, M={pp['n_micro']}, "
+                   f"L={pp['n_layers']}; interleaved v=2)"),
+        "value": round(gp_b / il_b, 2) if il_b > 0 else None,
+        "unit": "bubble_reduction_x",
+        "vs_baseline": None,
+        "schedules": measured,
+        "guard": guard,
+        "device": jax.devices()[0].device_kind,
+        "baseline_note": "reference has no pipeline parallelism; analytic "
+                         "bound: (P-1)/(M+P-1) vs (P-1)/(vM+P-1)",
+    }
+
+
 BENCHES = {
     "resnet50": bench_resnet50,
     "vit": bench_vit_b16,
     "gpt2": bench_gpt2,
     "decode": bench_gpt2_decode,
+    "pipeline": bench_pipeline,
 }
 
 
@@ -1038,7 +1204,8 @@ def main() -> None:
         _persist_record(dict(rec, profiled=True))
         return
     units = {"resnet50": "samples/sec/chip", "vit": "samples/sec/chip",
-             "gpt2": "tokens/sec/chip", "decode": "tokens/sec/chip"}
+             "gpt2": "tokens/sec/chip", "decode": "tokens/sec/chip",
+             "pipeline": "bubble_reduction_x"}
     # gpt2 stays LAST: the driver reads the final stdout line as the
     # headline record
     names = [args.only] if args.only else ["resnet50", "vit", "decode",

@@ -1,0 +1,143 @@
+"""Pipeline schedules demo: GPipe vs 1F1B vs interleaved, two ways.
+
+1. MPMD lockstep proxy (`rocket_tpu.parallel.mpmd.run_lockstep`) on a
+   tanh layer stack: prints the measured per-stage bubble table (from
+   the goodput ledger's ``pipeline/bubble/stage<p>`` buckets), the
+   analytic plan numbers, the 1F1B ``max_live`` residency bound, and a
+   bit-equality check of every schedule against the single-controller
+   reference program.
+2. SPMD engine through the full framework: a small ``TransformerLM``
+   with ``pipeline_schedule=<s>`` trains a few steps through
+   ``rt.Module`` on a ``pipe=2 x data=4`` mesh of fake CPU devices —
+   the per-step losses are IDENTICAL bits across all three schedules.
+
+Runs on CPU out of the box:
+
+    JAX_PLATFORMS=cpu python examples/pipeline_schedules.py
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def mpmd_demo(n_stages: int, n_micro: int, n_layers: int, width: int) -> None:
+    from rocket_tpu.parallel import mpmd
+
+    ks = jax.random.split(jax.random.PRNGKey(0), 4)
+    params = {"w": jax.random.normal(ks[0], (n_layers, width, width)) * 0.3,
+              "b": jax.random.normal(ks[1], (n_layers, width)) * 0.01}
+    micros = jax.random.normal(ks[2], (n_micro, 16, width))
+    target = jax.random.normal(ks[3], (16, width))
+
+    def layer(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(y):
+        return jnp.mean((y - target) ** 2)
+
+    ref_loss, ref_grads = mpmd.run_reference(
+        layer, params, micros, loss_fn, n_stages=n_stages
+    )
+    print(f"\nMPMD lockstep proxy  P={n_stages} M={n_micro} L={n_layers}")
+    print(f"{'schedule':<16}{'bubble':>8}{'plan':>8}{'max_live':>10}  "
+          f"bit-equal")
+    for sched, v in (("gpipe", 1), ("1f1b", 1), ("interleaved", 2)):
+        res = mpmd.run_lockstep(
+            layer, params, micros, loss_fn, n_stages=n_stages,
+            schedule=sched, n_chunks=v, goodput=False,
+        )
+        # interleaved re-chunks the grads; reference with matching chunks
+        r_loss, r_grads = mpmd.run_reference(
+            layer, params, micros, loss_fn, n_stages=n_stages, n_chunks=v
+        )
+        equal = np.array_equal(
+            np.asarray(res.loss), np.asarray(r_loss)
+        ) and all(
+            np.array_equal(np.asarray(a), np.asarray(b))
+            for a, b in zip(
+                jax.tree_util.tree_leaves(res.grads),
+                jax.tree_util.tree_leaves(r_grads),
+            )
+        )
+        live = max(r.max_live for r in res.reports)
+        name = f"{sched}(v={v})" if v > 1 else sched
+        print(f"{name:<16}{res.bubble_fraction:>8.3f}"
+              f"{res.plan['bubble_fraction']:>8.3f}{live:>10}  {equal}")
+    del ref_loss, ref_grads
+
+
+def spmd_demo(steps: int) -> None:
+    import rocket_tpu as rt
+    from rocket_tpu.models.objectives import lm_cross_entropy
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+    from rocket_tpu.parallel.mesh import MeshSpec
+
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(8, 16)), jnp.int32
+    )
+    print(f"\nSPMD engine through rt.Module  (pipe=2 x data=4, "
+          f"{steps} steps)")
+    runs = {}
+    for sched, v in (("gpipe", 1), ("1f1b", 1), ("interleaved", 2)):
+        runtime = rt.Runtime(mesh=MeshSpec(pipe=2, data=4))
+        cfg = TransformerConfig(
+            vocab_size=64, hidden=32, n_layers=4, n_heads=4, max_seq=32,
+            attention="dot", pipeline_microbatches=2,
+            pipeline_schedule=sched, pipeline_chunks=v,
+        )
+        mod = rt.Module(
+            TransformerLM(cfg),
+            capsules=[rt.Loss(lm_cross_entropy(), name="lm"),
+                      rt.Optimizer(learning_rate=1e-2)],
+        )
+        mod.bind(runtime)
+        mod.setup()
+        batch = jax.device_put({"tokens": tokens},
+                               runtime.batch_sharding(ndim=2))
+        attrs = rt.Attributes(
+            looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
+        )
+        losses = []
+        for _ in range(steps):
+            attrs.batch = batch
+            mod.launch(attrs)
+            losses.append(float(attrs.step_logs["lm"]))
+        runs[sched] = losses
+        print(f"  {sched:<12} losses: "
+              + "  ".join(f"{v:.9f}" for v in losses))
+        mod.destroy()
+    same = all(runs[s] == runs["gpipe"] for s in runs)
+    print(f"  per-step losses identical bits across schedules: {same}")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--stages", type=int, default=2)
+    ap.add_argument("--micro", type=int, default=8)
+    ap.add_argument("--layers", type=int, default=8)
+    ap.add_argument("--width", type=int, default=64)
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--skip-spmd", action="store_true",
+                    help="only the MPMD proxy table (faster)")
+    args = ap.parse_args()
+    mpmd_demo(args.stages, args.micro, args.layers, args.width)
+    if not args.skip_spmd:
+        spmd_demo(args.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -492,6 +492,15 @@ class Module(Dispatcher):
                         "skip_nonfinite guard is not supported with "
                         "fuse_accumulation — fused window steps run unguarded"
                     )
+                # the pipelined model's schedule keys the dispatch edge
+                # name so per-schedule retrace/goodput attribution works
+                sched = getattr(
+                    getattr(
+                        getattr(self._adapter, "module", None),
+                        "config", None,
+                    ),
+                    "pipeline_schedule", "gpipe",
+                )
                 self._steps = {
                     "window": build_window_step(
                         self._adapter.apply_fn,
@@ -500,6 +509,7 @@ class Module(Dispatcher):
                         policy=policy,
                         window=self._accum,
                         donate=donate,
+                        pipeline_schedule=sched,
                     )
                 }
             else:

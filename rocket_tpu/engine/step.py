@@ -365,6 +365,7 @@ def build_window_step(
     window: int = 1,
     log_grad_norm: bool = True,
     donate: Optional[bool] = True,
+    pipeline_schedule: str = "gpipe",
 ) -> Callable[[TrainState, Tuple[Any, ...]], Tuple[TrainState, Dict[str, Any]]]:
     """Fused gradient-accumulation step: ONE jitted call consumes the whole
     ``window``-batch accumulation window, concatenated on the batch dim,
@@ -389,9 +390,24 @@ def build_window_step(
     concatenated window row count are treated as batch-major per-example
     outputs (the blackboard batch-rewriting contract); other leaves pass
     through to every slice's objective unsliced.
+
+    ``pipeline_schedule`` names the schedule the pipelined model inside
+    ``apply_fn`` runs (selected by ``TransformerConfig.pipeline_schedule``;
+    Module threads it through automatically).  The schedule itself lives
+    in the model — here it keys the dispatch edge's trace/ledger name
+    (``train_step/dispatch/window_1f1b`` etc.), so retrace sentinels and
+    goodput attribution separate per schedule; all schedules are bit-equal
+    in loss/grads, so swapping them never changes training math.
     """
     if window < 1:
         raise ValueError("window must be >= 1")
+    from rocket_tpu.parallel.pipeline import SCHEDULES
+
+    if pipeline_schedule not in SCHEDULES:
+        raise ValueError(
+            f"pipeline_schedule {pipeline_schedule!r} unknown; choose "
+            f"from {SCHEDULES}"
+        )
 
     def _concat_rows(*xs):
         # Row-concat via scatter into a zeros buffer instead of
@@ -463,9 +479,12 @@ def build_window_step(
         )
 
     donate_argnums = (0,) if _resolve_donate(donate) else ()
+    edge = "train_step/dispatch/window"
+    if pipeline_schedule != "gpipe":
+        edge = f"{edge}_{pipeline_schedule}"
     return _annotated_dispatch(
         jax.jit(window_step, donate_argnums=donate_argnums),
-        "train_step/dispatch/window",
+        edge,
     )
 
 

@@ -160,12 +160,47 @@ class TransformerConfig:
     # size, amortizing the fill/drain bubble. Mutually exclusive with
     # pipeline_microbatches.
     pipeline_microbatch_size: int = 0
+    # Pipeline schedule (rocket_tpu.parallel.pipeline.SCHEDULES):
+    #   'gpipe'       — all forwards then the transposed backward;
+    #   '1f1b'        — same ticks, schedule-aware remat bounds the live
+    #                   activation stash to <=P microbatches;
+    #   'interleaved' — each stage holds pipeline_chunks non-contiguous
+    #                   layer chunks, bubble fraction ~1/chunks.
+    # All three are bit-equal in loss/grads; see docs/performance.md.
+    pipeline_schedule: str = "gpipe"
+    # Interleaved chunk count v (layer chunks per stage); must be 1 for
+    # the other schedules. Needs n_layers % (pipe * v) == 0.
+    pipeline_chunks: int = 1
 
     def __post_init__(self) -> None:
         if self.pipeline_microbatches and self.pipeline_microbatch_size:
             raise ValueError(
                 "pipeline_microbatches and pipeline_microbatch_size are "
                 "mutually exclusive"
+            )
+        from rocket_tpu.parallel.pipeline import SCHEDULES
+
+        if self.pipeline_schedule not in SCHEDULES:
+            raise ValueError(
+                f"pipeline_schedule {self.pipeline_schedule!r} unknown; "
+                f"choose from {SCHEDULES}"
+            )
+        if self.pipeline_chunks < 1:
+            raise ValueError(
+                f"pipeline_chunks must be >= 1, got {self.pipeline_chunks}"
+            )
+        if self.pipeline_chunks > 1 and self.pipeline_schedule != "interleaved":
+            raise ValueError(
+                f"pipeline_chunks={self.pipeline_chunks} requires "
+                f"pipeline_schedule='interleaved' "
+                f"(got {self.pipeline_schedule!r})"
+            )
+        if not self.pipelined and (
+            self.pipeline_schedule != "gpipe" or self.pipeline_chunks != 1
+        ):
+            raise ValueError(
+                "pipeline_schedule/pipeline_chunks need pipelining on — "
+                "set pipeline_microbatches or pipeline_microbatch_size"
             )
         if self.weights_int8 and self.fused_ce:
             raise ValueError(
@@ -612,15 +647,20 @@ def remat_policies(cfg: TransformerConfig):
 
 
 class PipelinedBlocks(nn.Module):
-    """The block stack, GPipe-pipelined over the mesh's ``pipe`` axis.
+    """The block stack, pipelined over the mesh's ``pipe`` axis under
+    ``config.pipeline_schedule`` (gpipe / 1f1b / interleaved — bit-equal
+    in loss and grads; see ``parallel.pipeline``).
 
     Parameters are created by the same ``nn.scan`` stacking as
     ``scan_layers`` but with the ``stage`` logical name on the layer dim
     (rule: ``stage -> pipe``), so each pipeline stage holds its ``L/P``
-    layer slice.  At apply time the stacked params are read back and driven
-    through :func:`rocket_tpu.parallel.pipeline.gpipe` — microbatches flow
-    stage-to-stage over ICI ``ppermute``.  Constraints: ``dropout == 0``
-    (the pure per-layer fn carries no rng) and no MoE aux (returns 0).
+    layer slice — ``v`` non-contiguous chunks of it under the interleaved
+    schedule, permuted internally while checkpoints keep the canonical
+    ascending-layer layout.  At apply time the stacked params are read
+    back and driven through :func:`rocket_tpu.parallel.pipeline.pipeline`
+    — microbatches flow stage-to-stage over ICI ``ppermute``.
+    Constraints: ``dropout == 0`` (the pure per-layer fn carries no rng)
+    and no MoE aux (returns 0).
     """
 
     config: TransformerConfig
@@ -642,7 +682,7 @@ class PipelinedBlocks(nn.Module):
             )(Block(cfg, name="blocks"), x, None)
             return out
         from rocket_tpu.parallel.context import current_mesh
-        from rocket_tpu.parallel.pipeline import gpipe
+        from rocket_tpu.parallel.pipeline import pipeline
 
         mesh = current_mesh()
         if mesh is None:
@@ -697,7 +737,11 @@ class PipelinedBlocks(nn.Module):
         # positions/segments are pass-through side inputs: emit only the
         # hidden state (no output buffer or final all-reduce for them)
         emit = (True,) + (False,) * (len(xs) - 1)
-        ys = gpipe(one_layer, stacked, xs, mesh=mesh, axis="pipe", emit=emit)
+        ys = pipeline(
+            one_layer, stacked, xs, mesh=mesh, axis="pipe",
+            schedule=cfg.pipeline_schedule, n_chunks=cfg.pipeline_chunks,
+            emit=emit,
+        )
         return ys[0].reshape(B, S, D)
 
 

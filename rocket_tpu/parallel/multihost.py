@@ -265,3 +265,76 @@ def to_host_global(value: Any) -> Any:
         for pos, host_global in zip(pending.keys(), gathered):
             out[pos] = host_global
     return jax.tree_util.tree_unflatten(treedef, out)
+
+
+# ---------------------------------------------------------------------------
+# per-stage process groups (MPMD pipeline over DCN)
+# ---------------------------------------------------------------------------
+#
+# The MPMD runner (rocket_tpu.parallel.mpmd) maps pipeline stages to
+# processes: each stage is a contiguous block of processes (one pod slice
+# per stage — ICI handles intra-stage sharding, the stage boundary rides
+# DCN).  These helpers are the pure mapping; they degrade to the
+# single-process identity exactly like the rest of this module.
+
+
+def stage_process_groups(
+    n_stages: int, n_processes: Optional[int] = None
+) -> list:
+    """Process ids per pipeline stage: ``n_processes`` split into
+    ``n_stages`` contiguous blocks (stage 0 = the lowest block, matching
+    jax's slice-major process numbering on multislice pods)."""
+    if n_processes is None:
+        n_processes = jax.process_count()
+    if n_stages < 1:
+        raise ValueError(f"n_stages must be >= 1, got {n_stages}")
+    if n_processes % n_stages != 0:
+        raise ValueError(
+            f"{n_processes} processes do not split into {n_stages} "
+            f"equal pipeline stages; run one process-block per stage"
+        )
+    per = n_processes // n_stages
+    return [
+        list(range(s * per, (s + 1) * per)) for s in range(n_stages)
+    ]
+
+
+def stage_of_process(
+    n_stages: int,
+    process_id: Optional[int] = None,
+    n_processes: Optional[int] = None,
+) -> int:
+    """Which pipeline stage this (or the given) process belongs to."""
+    if process_id is None:
+        process_id = jax.process_index()
+    if n_processes is None:
+        n_processes = jax.process_count()
+    groups = stage_process_groups(n_stages, n_processes)
+    per = n_processes // n_stages
+    if not 0 <= process_id < n_processes:
+        raise ValueError(
+            f"process_id {process_id} out of range for {n_processes}"
+        )
+    return process_id // per
+
+
+def stage_peers(
+    n_stages: int,
+    process_id: Optional[int] = None,
+    n_processes: Optional[int] = None,
+) -> list:
+    """The process ids sharing this process's stage (its intra-stage ICI
+    group — the domain `shard_map` programs span inside one stage)."""
+    if process_id is None:
+        process_id = jax.process_index()
+    stage = stage_of_process(n_stages, process_id, n_processes)
+    return stage_process_groups(n_stages, n_processes)[stage]
+
+
+def stage_neighbors(n_stages: int, stage: int) -> tuple:
+    """(previous, next) stage ids on the pipeline ring — the two DCN
+    edges a stage's transport endpoints connect (activations arrive from
+    ``prev``, cotangents from ``next``)."""
+    if not 0 <= stage < n_stages:
+        raise ValueError(f"stage {stage} out of range for {n_stages}")
+    return ((stage - 1) % n_stages, (stage + 1) % n_stages)

@@ -390,6 +390,24 @@ class PartitionRules:
 # sub-leaf rules (lora/bias/scale) come before their kernel's rule only
 # where patterns overlap; catch-alls for unannotated vision stacks go last.
 DEFAULT_PARTITION_RULES = PartitionRules(rules=(
+    # pipeline-stacked blocks (PipelinedBlocks, incl. the interleaved
+    # per-stage chunked layout): every param carries a leading layer dim
+    # scattered over 'stage', so these rows mirror the per-layer rules below
+    # with an explicit leading 'stage' axis.  They must precede the generic
+    # rows — patterns are searched and first match wins.  The interleaved
+    # schedule permutes *rows* of this same layout at dispatch time
+    # (``interleave_order``); checkpoints and manifests stay canonical, so
+    # one rule set covers every schedule.
+    (r"(^|/)pipeline/blocks/.*attn/(q|k|v|qkv)/(kernel|kernel_q)$", ("stage", "embed", "heads")),
+    (r"(^|/)pipeline/blocks/.*attn/(q|k|v|qkv)/(bias|kernel_scale)$", ("stage", "heads")),
+    (r"(^|/)pipeline/blocks/.*attn/o/(kernel|kernel_q)$", ("stage", "heads", "embed")),
+    (r"(^|/)pipeline/blocks/.*attn/o/(bias|kernel_scale)$", ("stage", "embed")),
+    (r"(^|/)pipeline/blocks/.*mlp/(gate|up)/(kernel|kernel_q)$", ("stage", "embed", "mlp")),
+    (r"(^|/)pipeline/blocks/.*mlp/(gate|up)/(bias|kernel_scale)$", ("stage", "mlp")),
+    (r"(^|/)pipeline/blocks/.*mlp/down/(kernel|kernel_q)$", ("stage", "mlp", "embed")),
+    (r"(^|/)pipeline/blocks/.*mlp/down/(bias|kernel_scale)$", ("stage", "embed")),
+    (r"(^|/)pipeline/blocks/.*(RMSNorm_\d+|LayerNorm_\d+)/scale$", ("stage", "norm")),
+    (r"(^|/)pipeline/blocks/.*LayerNorm_\d+/bias$", ("stage", None)),
     # attention projections (matches attn/, self_attn/, cross_attn/)
     (r"attn/(q|k|v|qkv)/(kernel|kernel_q)$", ("embed", "heads")),
     (r"attn/(q|k|v|qkv)/(bias|kernel_scale)$", ("heads",)),

@@ -84,6 +84,7 @@ _HEAVY_TAIL = (
     "test_tune.py",
     "test_multi_optimizer.py",
     "test_ladder_shapes.py",
+    "test_mpmd.py",
 )
 
 

@@ -9,6 +9,13 @@ assert_equal, per-host data sharding, and a multi-host Orbax
 save + restore through the full Launcher pipeline.
 
 Usage: python multiproc_worker.py <port> <num_processes> <process_id> <dir>
+
+MPMD mode (tests/test_mpmd.py): one PIPELINE STAGE per process, boundary
+activations/cotangents over a TCP-loopback SocketEndpoint instead of a
+jax.distributed rendezvous — the pod deployment shape of
+``rocket_tpu.parallel.mpmd`` with real process isolation.
+
+Usage: python multiproc_worker.py mpmd <port> <n_stages> <stage> <dir>
 """
 
 import os
@@ -160,5 +167,63 @@ def main() -> None:
     multihost.shutdown()
 
 
+def mpmd_main() -> None:
+    port, n_stages, stage, workdir = (
+        int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]), sys.argv[5]
+    )
+    import jax.numpy as jnp
+
+    from rocket_tpu.parallel.mpmd import (
+        ChunkPrograms,
+        SocketEndpoint,
+        run_stage,
+        split_chunks,
+    )
+
+    # the SAME seeded problem on every process (tests/test_mpmd.py
+    # _problem()): params/micros never cross the transport, only
+    # boundary activations and cotangents do
+    keys = jax.random.split(jax.random.PRNGKey(0), 4)
+    params = {
+        "w": jnp.stack([jax.random.normal(k, (8, 8)) * 0.3 for k in keys]),
+        "b": jnp.zeros((4, 8)),
+    }
+    micros = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 8))
+    target = jax.random.normal(jax.random.PRNGKey(2), (2, 8))
+
+    def layer(p, x):
+        return jnp.tanh(x @ p["w"] + p["b"])
+
+    def loss_fn(y):
+        return jnp.mean((y - target) ** 2)
+
+    if stage == 0:
+        endpoint = SocketEndpoint.listen(port, stage=stage)
+    else:
+        endpoint = SocketEndpoint.connect("127.0.0.1", port, stage=stage)
+    try:
+        programs = ChunkPrograms(layer, loss_fn)
+        chunk_params = split_chunks(params, n_stages)[stage]
+        grads, loss, report = run_stage(
+            stage, n_stages, programs, chunk_params, endpoint, n_micro=4,
+            schedule="1f1b", micros=micros if stage == 0 else None,
+            goodput=False,
+        )
+    finally:
+        endpoint.close()
+    out = {
+        "w": np.asarray(grads[0]["w"]),
+        "b": np.asarray(grads[0]["b"]),
+        "max_live": report.max_live,
+    }
+    if loss is not None:
+        out["loss"] = np.asarray(loss)
+    np.savez(os.path.join(workdir, f"mpmd_stage{stage}.npz"), **out)
+    print(f"MPMD-OK {stage}", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if sys.argv[1] == "mpmd":
+        mpmd_main()
+    else:
+        main()

@@ -1043,7 +1043,11 @@ def test_transformer_remat_inside_pipeline_matches(devices):
             def loss(params, m=m):
                 return lm_ce()(m.apply({"params": params}, batch, train=True))
 
-            value, grads = jax.value_and_grad(loss)(vs["params"])
+            # jit is required: the remat'd per-layer unit inside the
+            # pipeline (the cross-schedule bit-equality contract) cannot
+            # be transposed eagerly inside shard_map — real training is
+            # always jitted anyway
+            value, grads = jax.jit(jax.value_and_grad(loss))(vs["params"])
             results[remat] = (float(value), grads)
     np.testing.assert_allclose(results[False][0], results[True][0], rtol=1e-6)
     flat_a = jax.tree_util.tree_leaves_with_path(results[False][1])

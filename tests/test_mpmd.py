@@ -1,0 +1,352 @@
+"""MPMD pipeline runner tests (parallel/mpmd.py): per-stage 1F1B
+scheduling, transport discipline, bit-equality of the threaded and
+lockstep drivers against the single-controller reference, measured
+residency bounds, goodput bubble buckets, and the stage<->process
+mapping helpers.  The 2-process SocketEndpoint run (real OS processes,
+TCP loopback) is the slow tail."""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from rocket_tpu.observe.ledger import get_goodput, get_retrace_ledger
+from rocket_tpu.parallel import multihost
+from rocket_tpu.parallel.mpmd import (
+    ChunkPrograms,
+    QueueTransport,
+    SocketEndpoint,
+    merge_chunk_grads,
+    run_lockstep,
+    run_pipeline,
+    run_reference,
+    split_chunks,
+    stage_schedule,
+)
+
+
+def _layer(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _stack(rng, n_layers, width):
+    keys = jax.random.split(rng, n_layers)
+    return {
+        "w": jnp.stack([
+            jax.random.normal(k, (width, width)) * 0.3 for k in keys
+        ]),
+        "b": jnp.zeros((n_layers, width)),
+    }
+
+
+def _problem(n_layers=4, width=8, n_micro=4, micro_b=2):
+    params = _stack(jax.random.PRNGKey(0), n_layers, width)
+    micros = jax.random.normal(
+        jax.random.PRNGKey(1), (n_micro, micro_b, width)
+    )
+    target = jax.random.normal(jax.random.PRNGKey(2), (micro_b, width))
+    return params, micros, lambda y: jnp.mean((y - target) ** 2)
+
+
+def _sched_kwargs(schedule):
+    return {"schedule": schedule,
+            "n_chunks": 2 if schedule == "interleaved" else 1}
+
+
+# -- per-stage scheduler ----------------------------------------------------
+
+
+def test_stage_schedule_1f1b_bounds_inflight():
+    """1F1B at stage p: P-1-p warmup forwards, strict alternation, then
+    cooldown — the running forward-residual count never exceeds P - p,
+    and each backward lands in ascending microbatch order."""
+    P, M = 4, 8
+    for p in range(P):
+        items = stage_schedule("1f1b", p, P, M)
+        assert len(items) == 2 * M
+        live = peak = 0
+        bwd_seen = []
+        for kind, m, c in items:
+            assert c == 0
+            live += 1 if kind == "fwd" else -1
+            peak = max(peak, live)
+            if kind == "bwd":
+                bwd_seen.append(m)
+        assert live == 0
+        assert peak <= P - p, (p, peak)
+        assert bwd_seen == sorted(bwd_seen)
+
+
+def test_stage_schedule_gpipe_and_interleaved_order():
+    P, M, v = 2, 4, 2
+    gp = stage_schedule("gpipe", 0, P, M)
+    assert gp == (
+        [("fwd", m, 0) for m in range(M)] + [("bwd", m, 0) for m in range(M)]
+    )
+    il = stage_schedule("interleaved", 0, P, M, n_chunks=v)
+    # chunk slot ascending on the forward, descending on the backward;
+    # ascending micro within each chunk (the accumulation-order contract)
+    assert il[:M] == [("fwd", m, 0) for m in range(M)]
+    assert il[M:2 * M] == [("fwd", m, 1) for m in range(M)]
+    assert il[2 * M:3 * M] == [("bwd", m, 1) for m in range(M)]
+    assert il[3 * M:] == [("bwd", m, 0) for m in range(M)]
+
+
+def test_stage_schedule_validation():
+    with pytest.raises(ValueError, match="unknown schedule"):
+        stage_schedule("zigzag", 0, 2, 4)
+    with pytest.raises(ValueError, match="requires schedule='interleaved'"):
+        stage_schedule("1f1b", 0, 2, 4, n_chunks=2)
+    with pytest.raises(ValueError, match="out of range"):
+        stage_schedule("gpipe", 2, 2, 4)
+
+
+def test_split_merge_round_trip():
+    params, _, _ = _problem(n_layers=8)
+    for P, v in [(2, 1), (2, 2), (4, 1)]:
+        per_stage = split_chunks(params, P, v)
+        merged = merge_chunk_grads(per_stage, P, v)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)
+            ),
+            params, merged,
+        )
+    with pytest.raises(ValueError, match="not divisible"):
+        split_chunks(params, 3, 1)
+
+
+# -- threaded driver vs the single-controller reference ---------------------
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "interleaved"])
+def test_run_pipeline_bit_equal_to_reference(schedule):
+    """The CPU-emulated MPMD run (one thread per stage, QueueTransport)
+    is BITWISE equal to the single-controller replay of the same chunk
+    programs — the fixed accumulation-order contract, not a tolerance."""
+    params, micros, loss_fn = _problem()
+    kw = _sched_kwargs(schedule)
+    res = run_pipeline(_layer, params, micros, loss_fn, n_stages=2,
+                       goodput=False, **kw)
+    ref_loss, ref_grads = run_reference(
+        _layer, params, micros, loss_fn, n_stages=2,
+        n_chunks=kw["n_chunks"],
+    )
+    assert np.array_equal(np.asarray(res.loss), np.asarray(ref_loss))
+    mismatched = [
+        jax.tree_util.keystr(path)
+        for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(res.grads),
+            jax.tree_util.tree_leaves_with_path(ref_grads),
+        )
+        if not np.array_equal(np.asarray(a), np.asarray(b))
+    ]
+    assert not mismatched, mismatched
+
+
+def test_run_pipeline_1f1b_measured_residency():
+    """The ≤P residency bound is MEASURED, not just planned: under 1F1B
+    stage p peaks at ≤ P - p live microbatches while GPipe stashes all
+    M of them."""
+    params, micros, loss_fn = _problem(n_micro=8)
+    P = 2
+    fb = run_pipeline(_layer, params, micros, loss_fn, n_stages=P,
+                      schedule="1f1b", goodput=False)
+    for r in fb.reports:
+        assert r.max_live <= P - r.stage, (r.stage, r.max_live)
+    gp = run_pipeline(_layer, params, micros, loss_fn, n_stages=P,
+                      schedule="gpipe", goodput=False)
+    assert [r.max_live for r in gp.reports] == [8, 8]
+    assert fb.plan["live_microbatches"] <= P < gp.plan["live_microbatches"]
+
+
+def test_chunk_programs_exempt_from_retrace_sentinel():
+    """The MPMD jit edges are shape-polymorphic across configs — they
+    must be registered exempt so the zero-retrace sentinel never fires
+    on a legitimate config change."""
+    programs = ChunkPrograms(_layer)
+    exempt = get_retrace_ledger()._exempt
+    assert {programs.FWD, programs.BWD, programs.LOSS} <= exempt
+
+
+# -- lockstep driver: the bubble-measurement vehicle ------------------------
+
+
+def test_run_lockstep_bit_equal_and_goodput_buckets():
+    """Lockstep tick rounds keep the same loss/grad bits as the threaded
+    driver and the reference, and every stage's structural wait lands in
+    its pipeline/bubble/stage<p> goodput bucket."""
+    params, micros, loss_fn = _problem(n_micro=4)
+    gp = get_goodput()
+    was_armed = gp.armed
+    try:
+        gp.start_run()
+        res = run_lockstep(_layer, params, micros, loss_fn, n_stages=2,
+                           schedule="gpipe")
+        gp.end_run()
+        snap = gp.snapshot()
+    finally:
+        gp.armed = was_armed
+    ref_loss, ref_grads = run_reference(
+        _layer, params, micros, loss_fn, n_stages=2
+    )
+    assert np.array_equal(np.asarray(res.loss), np.asarray(ref_loss))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        res.grads, ref_grads,
+    )
+    for p in range(2):
+        key = f"pipeline/bubble/stage{p}_s"
+        assert key in snap, sorted(snap)
+        assert snap[key] == pytest.approx(res.reports[p].wait_s)
+    # GPipe on 2 stages must show a real fill/drain bubble
+    assert res.bubble_fraction > 0.0
+    assert res.plan["bubble_fraction"] == pytest.approx(1 / 5)
+
+
+def test_run_lockstep_interleaved_lower_tick_bubble():
+    """Structural claim at tick granularity (immune to timer noise): the
+    interleaved(v=2) walk spreads the same fill/drain idle rounds over
+    ~2x as many (half-size) work items, so its idle-per-tick fraction is
+    strictly below GPipe's — the ~1/v bubble cut the bench guard then
+    confirms in measured seconds."""
+    params, micros, loss_fn = _problem(n_layers=8, n_micro=8)
+
+    def tick_bubble(schedule):
+        res = run_lockstep(_layer, params, micros, loss_fn, n_stages=2,
+                           goodput=False, **_sched_kwargs(schedule))
+        # wait_s = idle_rounds x mean item seconds exactly, so the tick
+        # counts are recoverable from the report without trusting wall
+        # time: idle_rounds = wait_s / (busy_s / n_items)
+        idle = sum(
+            round(r.wait_s / (r.busy_s / r.n_items)) for r in res.reports
+        )
+        items = sum(r.n_items for r in res.reports)
+        return idle / (idle + items)
+
+    gp_b = tick_bubble("gpipe")
+    il_b = tick_bubble("interleaved")
+    assert 0.0 < il_b < gp_b, (gp_b, il_b)
+
+
+# -- stage <-> process mapping helpers --------------------------------------
+
+
+def test_stage_process_groups_mapping():
+    assert multihost.stage_process_groups(2, 8) == [
+        [0, 1, 2, 3], [4, 5, 6, 7]
+    ]
+    assert multihost.stage_process_groups(4, 4) == [[0], [1], [2], [3]]
+    with pytest.raises(ValueError, match="do not split"):
+        multihost.stage_process_groups(3, 8)
+    assert multihost.stage_of_process(2, process_id=5, n_processes=8) == 1
+    assert multihost.stage_peers(2, process_id=5, n_processes=8) == [
+        4, 5, 6, 7
+    ]
+    assert multihost.stage_neighbors(4, 0) == (3, 1)
+    assert multihost.stage_neighbors(4, 3) == (2, 0)
+    with pytest.raises(ValueError, match="out of range"):
+        multihost.stage_neighbors(4, 4)
+    # single-process degradation: everything is stage 0
+    assert multihost.stage_process_groups(1, 1) == [[0]]
+    assert multihost.stage_of_process(1, process_id=0, n_processes=1) == 0
+
+
+# -- socket transport -------------------------------------------------------
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_socket_endpoint_reorders_tagged_frames():
+    """The TCP endpoint delivers by (src, tag), not arrival order — the
+    reorder buffer is what lets a 1F1B consumer pull the frame its
+    schedule wants next."""
+    port = _free_port()
+    holder = {}
+
+    def serve():
+        ep = SocketEndpoint.listen(port, stage=1)
+        holder["server"] = ep
+        ep.send(0, ("a", 1, 1), jnp.full((2,), 7.0))
+        ep.send(0, ("a", 1, 0), jnp.full((2,), 3.0))
+        ep.send(0, ("a", 1, 2), jnp.full((2,), 9.0))
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    client = SocketEndpoint.connect("127.0.0.1", port, stage=0)
+    try:
+        t.join(timeout=30)
+        # ask for the SECOND-sent frame first
+        v0, _ = client.recv(1, ("a", 1, 0), timeout=30)
+        v1, _ = client.recv(1, ("a", 1, 1), timeout=30)
+        np.testing.assert_array_equal(np.asarray(v0), np.full((2,), 3.0))
+        np.testing.assert_array_equal(np.asarray(v1), np.full((2,), 7.0))
+        # a frame whose src does not match the expected peer is an error
+        # (the third frame is still in flight, so _next has one to read)
+        with pytest.raises(ValueError, match="expected frames from"):
+            client._next(src=5, timeout=30)
+    finally:
+        client.close()
+        holder["server"].close()
+
+
+@pytest.mark.slow
+def test_mpmd_two_real_processes_bit_equal(tmp_path):
+    """REAL 2-process MPMD: two OS processes, one pipeline stage each,
+    activations/cotangents over TCP loopback (SocketEndpoint) — the
+    merged result is bit-equal to the single-controller program."""
+    port = _free_port()
+    worker = os.path.join(os.path.dirname(__file__), "multiproc_worker.py")
+    env = dict(os.environ)
+    env["PYTHONPATH"] = (
+        os.path.dirname(os.path.dirname(worker))
+        + os.pathsep + env.get("PYTHONPATH", "")
+    )
+    env.pop("XLA_FLAGS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, "mpmd", str(port), "2", str(stage),
+             str(tmp_path)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=env,
+        )
+        for stage in range(2)
+    ]
+    outs = []
+    for stage, proc in enumerate(procs):
+        out, _ = proc.communicate(timeout=300)
+        outs.append(out)
+        assert proc.returncode == 0, f"stage {stage} failed:\n{out}"
+        assert f"MPMD-OK {stage}" in out, out
+
+    params, micros, loss_fn = _problem()
+    ref_loss, ref_grads = run_reference(
+        _layer, params, micros, loss_fn, n_stages=2
+    )
+    g0 = np.load(tmp_path / "mpmd_stage0.npz")
+    g1 = np.load(tmp_path / "mpmd_stage1.npz")
+    merged = merge_chunk_grads(
+        [{0: {"w": g0["w"], "b": g0["b"]}}, {0: {"w": g1["w"], "b": g1["b"]}}],
+        n_stages=2, n_chunks=1,
+    )
+    assert np.array_equal(float(g1["loss"]), np.asarray(ref_loss))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b)
+        ),
+        merged, ref_grads,
+    )
+    # the residency bound held across real processes too
+    assert int(g0["max_live"]) <= 2 and int(g1["max_live"]) <= 1

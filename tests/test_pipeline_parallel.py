@@ -8,7 +8,14 @@ import numpy as np
 import pytest
 
 from rocket_tpu.parallel.mesh import MeshSpec
-from rocket_tpu.parallel.pipeline import gpipe, _chunk_apply
+from rocket_tpu.parallel.pipeline import (
+    SCHEDULES,
+    _chunk_apply,
+    gpipe,
+    interleave_order,
+    pipeline,
+    schedule_plan,
+)
 
 
 def _layer(params, x):
@@ -55,8 +62,11 @@ def test_gpipe_gradients_match_sequential(devices):
     def loss_seq(p):
         return jnp.mean((_chunk_apply(_layer, p, xs) - target) ** 2)
 
-    g_pipe = jax.grad(loss_pipe)(params)
-    g_seq = jax.grad(loss_seq)(params)
+    # jit is required: the remat'd per-layer unit inside _chunk_apply
+    # (the cross-schedule bit-equality contract) cannot be transposed
+    # eagerly inside shard_map — real training is always jitted anyway
+    g_pipe = jax.jit(jax.grad(loss_pipe))(params)
+    g_seq = jax.jit(jax.grad(loss_seq))(params)
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=1e-5
@@ -625,3 +635,204 @@ def test_fused_window_resume_restarts_window(devices, tmp_path):
     # fresh windows; training completed both epochs with a sane count
     assert model2.step > model.step
     assert model2._window_buffer == []  # nothing stranded
+
+# -- schedule-parameterized engine: 1F1B + interleaved ----------------------
+
+
+def _sched_kwargs(schedule):
+    return {"schedule": schedule,
+            "n_chunks": 2 if schedule == "interleaved" else 1}
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "interleaved"])
+def test_schedules_bit_equal_to_gpipe_oracle(devices, schedule):
+    """1F1B and interleaved(v=2) are BITWISE equal to the GPipe oracle in
+    loss AND gradients — not allclose: the schedules share the per-layer
+    compiled unit in _chunk_apply and a fixed accumulation order, so the
+    only permitted difference is communication pattern."""
+    mesh = MeshSpec(pipe=4, data=2).build(devices)
+    width, n_micro, micro_b, n_layers = 8, 8, 2, 8
+    params = _stack(jax.random.PRNGKey(0), n_layers, width)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (n_micro, micro_b, width))
+    target = jax.random.normal(jax.random.PRNGKey(2), xs.shape)
+
+    def make_loss(**kw):
+        def loss(p):
+            ys = pipeline(_layer, p, xs, mesh=mesh, **kw)
+            return jnp.mean((ys - target) ** 2)
+        return loss
+
+    l_ref, g_ref = jax.jit(jax.value_and_grad(make_loss()))(params)
+    l_got, g_got = jax.jit(
+        jax.value_and_grad(make_loss(**_sched_kwargs(schedule)))
+    )(params)
+    assert np.array_equal(np.asarray(l_ref), np.asarray(l_got))
+    mismatched = [
+        jax.tree_util.keystr(path)
+        for (path, a), (_, b) in zip(
+            jax.tree_util.tree_leaves_with_path(g_ref),
+            jax.tree_util.tree_leaves_with_path(g_got),
+        )
+        if not np.array_equal(np.asarray(a), np.asarray(b))
+    ]
+    assert not mismatched, mismatched
+
+
+def test_schedule_plan_residency_and_bubble():
+    """Analytic plan: 1F1B bounds live activations to min(P, M) <= P while
+    GPipe stashes all M; interleaved(v) cuts the bubble fraction ~1/v."""
+    P_, M, act = 4, 16, 1024
+    gp = schedule_plan("gpipe", P_, M, micro_act_bytes=act)
+    fb = schedule_plan("1f1b", P_, M, micro_act_bytes=act)
+    il = schedule_plan("interleaved", P_, M, n_chunks=2, micro_act_bytes=act)
+    assert gp["live_microbatches"] == M
+    assert fb["live_microbatches"] == min(P_, M) <= P_
+    assert il["live_microbatches"] == min(P_, M)
+    assert fb["live_activation_bytes"] == fb["live_microbatches"] * act
+    assert gp["bubble_fraction"] == (P_ - 1) / (M + P_ - 1)
+    assert il["bubble_fraction"] == (P_ - 1) / (2 * M + P_ - 1)
+    assert il["bubble_fraction"] < gp["bubble_fraction"]
+    assert fb["bubble_fraction"] == gp["bubble_fraction"]
+    # 1f1b at M < P cannot hold more than M
+    assert schedule_plan("1f1b", 8, 2)["live_microbatches"] == 2
+
+
+def test_schedule_plan_matches_memory_plan_accounting(devices):
+    """The plan's live_activation_bytes composes with memory_plan()'s byte
+    accounting: 1F1B's stash on the pipelined transformer is P/M of
+    GPipe's, computed from the same micro activation size the bench
+    records."""
+    micro_act = 2 * 16 * 32 * 4  # micro_b x seq x hidden x f32
+    gp = schedule_plan("gpipe", 2, 4, micro_act_bytes=micro_act)
+    fb = schedule_plan("1f1b", 2, 4, micro_act_bytes=micro_act)
+    assert gp["live_activation_bytes"] == 4 * micro_act
+    assert fb["live_activation_bytes"] == 2 * micro_act
+    assert fb["live_activation_bytes"] * 2 == gp["live_activation_bytes"]
+
+
+def test_interleave_order_round_trips():
+    """canonical -> stage-chunked permutation: stage p gets chunks
+    k = c*P + p back to back, and applying the inverse restores the
+    canonical layer order (the checkpoint layout is never permuted)."""
+    order = interleave_order(8, n_stages=2, n_chunks=2)
+    assert order.tolist() == [0, 1, 4, 5, 2, 3, 6, 7]
+    inv = np.empty_like(order)
+    inv[order] = np.arange(len(order))
+    assert np.array_equal(np.arange(8), order[inv])
+
+
+def test_pipeline_rejects_bad_interleave_chunking(devices):
+    mesh = MeshSpec(pipe=4, data=2).build(devices)
+    params = _stack(jax.random.PRNGKey(0), 8, 8)
+    xs = jnp.zeros((8, 2, 8))
+    # L=8 not divisible by P*v=12 — message names the remedy
+    with pytest.raises(ValueError, match=r"pick n_chunks so L % \(P\*n_chunks\) == 0".replace("%", "%")):
+        pipeline(_layer, params, xs, mesh=mesh,
+                 schedule="interleaved", n_chunks=3)
+    # M=3 not divisible by P=4 under interleaved
+    with pytest.raises(ValueError, match="pad the microbatch count"):
+        pipeline(_layer, params, jnp.zeros((3, 2, 8)), mesh=mesh,
+                 schedule="interleaved", n_chunks=2)
+
+
+def test_pipeline_rejects_schedule_misuse(devices):
+    mesh = MeshSpec(pipe=2, data=4).build(devices)
+    params = _stack(jax.random.PRNGKey(0), 4, 8)
+    xs = jnp.zeros((2, 2, 8))
+    with pytest.raises(ValueError, match="unknown schedule"):
+        pipeline(_layer, params, xs, mesh=mesh, schedule="zigzag")
+    with pytest.raises(ValueError, match="requires schedule='interleaved'"):
+        pipeline(_layer, params, xs, mesh=mesh, schedule="1f1b", n_chunks=2)
+    with pytest.raises(ValueError, match="n_chunks must be >= 1"):
+        pipeline(_layer, params, xs, mesh=mesh,
+                 schedule="interleaved", n_chunks=0)
+
+
+def test_pipeline_rejects_xs_spec_length_mismatch(devices):
+    from jax.sharding import PartitionSpec as P
+
+    mesh = MeshSpec(pipe=2, data=4).build(devices)
+    params = _stack(jax.random.PRNGKey(0), 4, 8)
+    xs = (jnp.zeros((2, 4, 8)), jnp.zeros((2, 4), jnp.int32))
+    with pytest.raises(ValueError, match="xs_spec has 3 specs, xs has 2"):
+        pipeline(_layer, params, xs, mesh=mesh,
+                 xs_spec=(P(("data",)), P(("data",)), P()))
+
+
+@pytest.mark.parametrize("schedule", sorted(SCHEDULES))
+def test_schedules_single_stage_degrade(devices, schedule):
+    """pipe absent (n_stages == 1): every schedule falls back to the same
+    sequential per-layer path, bit-equal to _chunk_apply."""
+    mesh = MeshSpec(data=8).build(devices)
+    params = _stack(jax.random.PRNGKey(0), 4, 8)
+    xs = jax.random.normal(jax.random.PRNGKey(1), (4, 2, 8))
+    got = jax.jit(
+        lambda p: pipeline(_layer, p, xs, mesh=mesh,
+                           **_sched_kwargs(schedule))
+    )(params)
+    ref = jax.jit(lambda p: _chunk_apply(_layer, p, xs))(params)
+    assert np.array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_config_schedule_validation():
+    from rocket_tpu.models.transformer import TransformerConfig
+
+    base = dict(vocab_size=64, hidden=32, n_layers=4, n_heads=4, max_seq=32)
+    with pytest.raises(ValueError, match="unknown"):
+        TransformerConfig(**base, pipeline_microbatches=2,
+                          pipeline_schedule="zigzag")
+    with pytest.raises(ValueError, match="pipeline_chunks must be >= 1"):
+        TransformerConfig(**base, pipeline_microbatches=2, pipeline_chunks=0)
+    with pytest.raises(ValueError, match="requires"):
+        TransformerConfig(**base, pipeline_microbatches=2, pipeline_chunks=2)
+    with pytest.raises(ValueError, match="need pipelining on"):
+        TransformerConfig(**base, pipeline_schedule="1f1b")
+    # the valid spellings construct
+    TransformerConfig(**base, pipeline_microbatches=2,
+                      pipeline_schedule="1f1b")
+    TransformerConfig(**base, pipeline_microbatches=2,
+                      pipeline_schedule="interleaved", pipeline_chunks=2)
+
+
+def test_transformer_schedules_bit_equal_through_module(devices):
+    """Full framework path under each schedule: three jitted train steps
+    produce IDENTICAL loss bits — the schedule knob changes communication
+    and residency, never numerics."""
+    import rocket_tpu as rt
+    from rocket_tpu.models.objectives import lm_cross_entropy
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, 64, size=(8, 16)), jnp.int32
+    )
+    losses = {}
+    for schedule in ("gpipe", "1f1b", "interleaved"):
+        runtime = rt.Runtime(mesh=MeshSpec(pipe=2, data=4))
+        cfg = TransformerConfig(
+            vocab_size=64, hidden=32, n_layers=4, n_heads=4, max_seq=32,
+            attention="dot", pipeline_microbatches=2,
+            pipeline_schedule=schedule,
+            pipeline_chunks=2 if schedule == "interleaved" else 1,
+        )
+        mod = rt.Module(
+            TransformerLM(cfg),
+            capsules=[rt.Loss(lm_cross_entropy(), name="lm"),
+                      rt.Optimizer(learning_rate=1e-2)],
+        )
+        mod.bind(runtime)
+        mod.setup()
+        batch = jax.device_put({"tokens": tokens},
+                               runtime.batch_sharding(ndim=2))
+        attrs = rt.Attributes(
+            looper=rt.Attributes(grad_enabled=True, state=rt.Attributes())
+        )
+        run = []
+        for _ in range(3):
+            attrs.batch = batch
+            mod.launch(attrs)
+            run.append(float(attrs.step_logs["lm"]))
+        losses[schedule] = run
+        mod.destroy()
+    assert losses["1f1b"] == losses["gpipe"], losses
+    assert losses["interleaved"] == losses["gpipe"], losses
+    assert losses["gpipe"][-1] < losses["gpipe"][0]

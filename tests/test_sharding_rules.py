@@ -230,6 +230,11 @@ def _zoo_configs():
         "moe": (TransformerLM(TransformerConfig(
             vocab_size=64, hidden=16, n_layers=2, n_heads=2, ffn_dim=32,
             max_seq=8, n_experts=4, moe_top_k=2, use_bias=True)), tok),
+        "transformer-pipelined": (TransformerLM(TransformerConfig(
+            vocab_size=64, hidden=16, n_layers=4, n_heads=2, ffn_dim=32,
+            max_seq=8, use_bias=True, tie_embeddings=True,
+            pipeline_microbatches=2, pipeline_schedule="interleaved",
+            pipeline_chunks=2)), tok),
         "vit": (ViT(ViTConfig.tiny()), img32),
         "resnet": (resnet18(num_classes=10), img32),
         "seq2seq": (EncoderDecoder(Seq2SeqConfig(
@@ -243,7 +248,7 @@ def _zoo_configs():
 
 @pytest.mark.parametrize("name", [
     "transformer", "transformer-scan", "transformer-int8", "moe",
-    "vit", "resnet", "seq2seq", "lenet",
+    "transformer-pipelined", "vit", "resnet", "seq2seq", "lenet",
 ])
 def test_zoo_default_rules_match_annotations(name):
     """CI lint: every model-zoo config gets a fully-matched spec tree from
