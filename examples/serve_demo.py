@@ -34,6 +34,16 @@ request trace so the two disciplines are directly comparable:
   ``--kill-round K`` kills replica r0 live so the self-healing path
   (drain, salvage, rebuild from factory, re-route) prints as it runs.
   See docs/reliability.md ("Serving fleet").
+- ``--mode fleet-proc`` — the fleet across REAL processes: each replica
+  is a :class:`rocket_tpu.serve.ProcReplica` supervising a
+  ``python -m rocket_tpu.serve.worker`` subprocess (tiny seeded models,
+  so outputs stay bit-comparable to an in-process oracle), routed by
+  pages through a shared prefix index.  ``--kill-round K`` SIGKILLs
+  w0's worker mid-burst and the supervisor salvage + respawn path
+  prints as it runs; ``--autoscale`` starts at ONE worker and lets the
+  goodput-driven :class:`rocket_tpu.serve.Autoscaler` grow the fleet
+  off the exported metrics and drain it after the burst.  See
+  docs/reliability.md ("Process fleet & autoscaling").
 - ``--mode cache`` — the prefix-cache tier
   (:class:`rocket_tpu.serve.PrefixKVStore`): a seeded multi-turn trace
   where 90% of every prompt is a session header shared across turns
@@ -64,7 +74,9 @@ frontiers, no per-token host sync) and report per-request latency
 
 import argparse
 import os
+import shutil
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
@@ -493,6 +505,175 @@ def run_fleet(args, model, draft, params, draft_params, arrivals, prompts):
                 accepted=0, drafted=0, tally=tally)
 
 
+def run_fleet_proc(args, model, draft, params, draft_params,
+                   arrivals, prompts):
+    """Process-backed fleet: every replica is a real ``python -m
+    rocket_tpu.serve.worker`` subprocess (the tiny testing model — the
+    WorkerSpec names a module-level builder, and seeded init makes all
+    workers bit-identical).  A seeded burst storms the fleet, replica
+    w0's worker takes a REAL ``kill -9`` mid-burst (``--kill-round``
+    picks the beat; default a third into the burst, ``-2`` disables),
+    and the supervisor's shadow salvages its in-flight requests onto
+    the survivors while the corpse respawns.  ``--autoscale`` starts at
+    ONE worker and lets the goodput-driven :class:`rocket_tpu.serve.
+    Autoscaler` grow the fleet off the /metrics surface (TTFT p95 SLO),
+    then drain it once the burst passes.  See docs/reliability.md
+    ("Process fleet & autoscaling")."""
+    from rocket_tpu.serve import (
+        Autoscaler, Completed, DeadlineExceeded, Failed, FleetRouter,
+        Overloaded, ProcReplica, Request, SharedPrefixIndex, SLOPolicy,
+        WorkerSpec, register_fleet_source,
+    )
+    from rocket_tpu.observe.export import unregister_source
+    from rocket_tpu.testing import workers as tw
+    from rocket_tpu.testing.chaos import ProcessKillInjector, bursty_arrivals
+
+    R = args.requests
+    rng = np.random.default_rng(23)
+    prompts = rng.integers(1, tw.VOCAB, size=(R, tw.P)).astype(np.int32)
+    burst = args.burst if args.burst > 0 else 8
+    arrivals = np.asarray(bursty_arrivals(R, burst, gap_s=0.25,
+                                          spread_s=0.02))
+    # every spawn — including the post-kill respawn — restores weights
+    # through the elastic-restore gate (newest valid snapshot,
+    # check_reshard against whatever devices the worker got)
+    snap_root = tempfile.mkdtemp(prefix="rocket_tpu_fleet_proc_")
+    snap_path = tw.save_tiny_snapshot(snap_root)
+    print(f"  [proc] workers elastic-restore from {snap_path}")
+    spec = WorkerSpec(
+        builder="rocket_tpu.testing.workers:build_tiny_loop",
+        kwargs={"queue_capacity": max(args.queue_capacity, 16),
+                "kvstore_page_tokens": 4,
+                "restore_dir": snap_root},
+    )
+    index = SharedPrefixIndex(page_tokens=4)
+    n0 = 1 if args.autoscale else min(max(args.replicas, 2), 4)
+
+    def spawn(rid):
+        t = time.perf_counter()
+        rep = ProcReplica(spec, rid, prefix_index=index)
+        print(f"  [proc] spawned worker {rid} (pid {rep.pid}) in "
+              f"{time.perf_counter() - t:.1f}s")
+        return rep
+
+    reps = [spawn(f"w{i}") for i in range(n0)]
+    router = FleetRouter(reps, prefix_index=index)
+    register_fleet_source(router)
+    auto = None
+    if args.autoscale:
+        auto = Autoscaler(router, spawn, SLOPolicy(
+            ttft_p95_ms=5.0, max_shed_rate=0.02, breach_rounds=1,
+            min_replicas=1, max_replicas=4,
+            scale_up_cooldown_s=0.0, scale_down_cooldown_s=0.0,
+            drain_below_load=0.5))
+        print("  [proc] autoscaler armed: TTFT p95 SLO 5 ms, "
+              "1..4 worker processes")
+    kill_tick = args.kill_round if args.kill_round >= 0 else max(2, R // 3)
+    injector = None
+    if args.kill_round != -2:
+        injector = ProcessKillInjector(reps[0], kill_on=(kill_tick,))
+        print(f"  [proc] chaos armed: SIGKILL {reps[0].replica_id}'s "
+              f"worker at burst beat {kill_tick}")
+    print(f"  [proc] serving {R} requests (bursts of {burst}) across "
+          f"{len(router.replicas)} worker process(es)")
+
+    t0 = time.perf_counter()
+    # each worker process runs on its OWN clock — supervisor-side wall
+    # latency (submit -> result drained here) is the comparable number
+    done_wall = {}
+    heals = 0
+    submitted = 0
+    results = []
+
+    def harvest(batch):
+        t_now = time.perf_counter() - t0
+        for r in batch:
+            done_wall[r.rid] = t_now
+        results.extend(batch)
+
+    while submitted < R:
+        while submitted < R and arrivals[submitted] <= time.perf_counter() - t0:
+            router.submit(Request(
+                rid=submitted, prompt=prompts[submitted]))
+            submitted += 1
+            # the injector counts burst beats (submissions), so the
+            # SIGKILL lands with requests genuinely in flight
+            if injector is not None and injector.tick():
+                print(f"  [proc] kill -9 delivered to "
+                      f"{reps[0].replica_id}'s worker mid-burst")
+        router.pump()       # supervision: discover the corpse, salvage,
+        if auto is not None:
+            auto.step()     # respawn; autoscaler reads the live metrics
+        if router.counters.heals > heals:
+            heals = router.counters.heals
+            print(f"  [proc] healed: {heals} heal(s), "
+                  f"{router.counters.requeued} request(s) salvaged from "
+                  f"the supervisor shadow and re-routed")
+        harvest(router.drain_results())
+    harvest(router.run_until_idle(max_rounds=1_000_000))
+    if router.counters.heals > heals:
+        heals = router.counters.heals
+        print(f"  [proc] healed: {heals} heal(s), "
+              f"{router.counters.requeued} request(s) salvaged from "
+              f"the supervisor shadow and re-routed")
+    total = time.perf_counter() - t0
+
+    if auto is not None:
+        # the burst has passed: relax the latency SLO (cumulative
+        # percentiles never decay) and let the cold-fleet trigger drain
+        auto.policy.ttft_p95_ms = float("inf")
+        for _ in range(30):
+            auto.step()
+            router.pump()
+            if auto.counters.scale_downs > 0 and not router._retiring:
+                break
+        for ev in auto.events:
+            print(f"  [proc] autoscale event: {ev['action']} "
+                  f"{ev['replica']}")
+        print(f"  [proc] autoscaler: {auto.counters.scale_ups} scale-up(s),"
+              f" {auto.counters.scale_downs} scale-down(s), "
+              f"{len(router.replicas)} worker(s) remain")
+
+    kinds = {Completed: "completed", Overloaded: "overloaded",
+             DeadlineExceeded: "deadline", Failed: "failed"}
+    tally = {v: 0 for v in kinds.values()}
+    served_by = {}
+    for r in results:
+        tally[kinds[type(r)]] += 1
+        if isinstance(r, Completed):
+            rep_id = (r.meta or {}).get("replica")
+            served_by[rep_id] = served_by.get(rep_id, 0) + 1
+    snap = router.snapshot()
+    print(f"  [proc] results: {tally} "
+          f"({len(results)}/{R} typed — exactly once)")
+    print("  [proc] served by: "
+          + "  ".join(f"{k}={v}" for k, v in sorted(served_by.items(),
+                                                    key=str)))
+    print(f"  [proc] routed {int(snap['routed'])}, heals "
+          f"{int(snap['heals'])}, requeued {int(snap['requeued'])}, "
+          f"pages-routed {int(snap['pages_routed'])}, shed "
+          f"{int(snap['shed_saturated'])}")
+    summary = router.latency().summary()
+    for name in ("ttft_ms", "tpot_ms", "e2e_ms"):
+        p50 = summary.get(f"{name}/p50")
+        if p50 is not None:
+            print(f"  [proc] {name:<8} p50 {p50:8.1f}  "
+                  f"p95 {summary[f'{name}/p95']:8.1f} "
+                  f"(merged across worker processes)")
+    router.close()
+    unregister_source("serve_fleet")
+    if auto is not None:
+        unregister_source("autoscaler")
+    shutil.rmtree(snap_root, ignore_errors=True)
+
+    done = [r for r in results if isinstance(r, Completed)]
+    lat = np.asarray([done_wall[r.rid] - arrivals[r.rid] for r in done])
+    return dict(lat=lat * 1e3 if lat.size else np.zeros(1), total=total,
+                dispatches=int(snap["routed"]), unit="routes",
+                accepted=0, drafted=0, tally=tally,
+                new_tokens=tw.TOTAL - tw.P)
+
+
 def run_cache(args, model, draft, params, draft_params, arrivals, prompts):
     """Prefix-cache tier (:mod:`rocket_tpu.serve.kvstore`): a seeded
     multi-turn trace where ~90% of every prompt is a session header
@@ -607,8 +788,9 @@ def run_cache(args, model, draft, params, draft_params, arrivals, prompts):
 
 def _report(name, res, n_requests):
     lat = res["lat"]
+    new = res.get("new_tokens", NEW)
     print(f"[{name}] served {n_requests} requests in {res['dispatches']} "
-          f"{res['unit']} ({n_requests * NEW / res['total']:.0f} tok/s "
+          f"{res['unit']} ({n_requests * new / res['total']:.0f} tok/s "
           f"aggregate)")
     print(f"[{name}] latency ms: p50 {np.percentile(lat, 50):.0f}  "
           f"p90 {np.percentile(lat, 90):.0f}  max {lat.max():.0f}")
@@ -629,8 +811,13 @@ def main():
                         help="mean simulated inter-arrival gap")
     parser.add_argument("--mode",
                         choices=("group", "continuous", "both", "robust",
-                                 "fleet", "cache"),
+                                 "fleet", "fleet-proc", "cache"),
                         default="both")
+    parser.add_argument("--autoscale", action="store_true",
+                        help="[fleet-proc] start at ONE worker process "
+                             "and let the goodput-driven Autoscaler "
+                             "grow/drain the fleet off the metrics "
+                             "surface (TTFT p95 SLO)")
     parser.add_argument("--kv-bytes", type=int, default=1 << 28,
                         help="[cache] prefix-store byte budget (LRU "
                              "eviction past it)")
@@ -642,7 +829,10 @@ def main():
     parser.add_argument("--kill-round", type=int, default=-1,
                         help="[fleet] kill replica r0 on this round via "
                              "ReplicaKillInjector; the router drains, "
-                             "salvages, and rebuilds it live (-1 = off)")
+                             "salvages, and rebuilds it live (-1 = off). "
+                             "[fleet-proc] the burst beat that SIGKILLs "
+                             "w0's worker (-1 = a third into the burst, "
+                             "-2 = no kill)")
     parser.add_argument("--queue-capacity", type=int, default=16,
                         help="[robust] bounded admission queue size; a "
                              "full queue rejects with a typed Overloaded")
@@ -692,7 +882,12 @@ def main():
     prompts = rng.integers(0, VOCAB, size=(args.requests, PROMPT))
     max_seq = (CACHE_PROMPT + NEW + NDRAFT if args.mode == "cache"
                else PROMPT + NEW + NDRAFT)
-    model, draft, params, draft_params = _build(max_seq=max_seq)
+    if args.mode == "fleet-proc":
+        # worker subprocesses build their own tiny models from a
+        # WorkerSpec — nothing big to construct in this process
+        model = draft = params = draft_params = None
+    else:
+        model, draft, params, draft_params = _build(max_seq=max_seq)
 
     metrics = None
     if args.metrics_port >= 0:
@@ -708,7 +903,7 @@ def main():
 
     runners = {"group": run_group, "continuous": run_continuous,
                "robust": run_robust, "fleet": run_fleet,
-               "cache": run_cache}
+               "fleet-proc": run_fleet_proc, "cache": run_cache}
     modes = ["group", "continuous"] if args.mode == "both" else [args.mode]
     results = {}
     try:

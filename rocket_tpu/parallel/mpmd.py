@@ -41,10 +41,7 @@ contract, not a tolerance.
 
 from __future__ import annotations
 
-import pickle
 import queue
-import socket
-import struct
 import threading
 import time
 from dataclasses import dataclass
@@ -66,6 +63,7 @@ from rocket_tpu.parallel.pipeline import (
     _chunk_apply,
     schedule_plan,
 )
+from rocket_tpu.utils.framing import FramedSocket
 
 #: ``(kind, micro, chunk_slot)`` with kind in {"fwd", "bwd"}.
 WorkItem = Tuple[str, int, int]
@@ -205,16 +203,17 @@ class _QueueEndpoint(_TaggedReceiver):
 
 class SocketEndpoint(_TaggedReceiver):
     """Point-to-point transport endpoint over one TCP socket —
-    length-prefixed pickled ``(src, tag, ndarray)`` frames.  The loopback
-    form backs the real 2-process CPU test; the identical framing is what
-    a DCN bridge between pod slices carries (one endpoint per neighbor
+    length-prefixed pickled ``(src, tag, ndarray)`` frames on the shared
+    :class:`~rocket_tpu.utils.framing.FramedSocket` discipline (the same
+    bytes the serving fleet's wire protocol rides).  The loopback form
+    backs the real 2-process CPU test; the identical framing is what a
+    DCN bridge between pod slices carries (one endpoint per neighbor
     edge, see ``multihost.stage_neighbors``)."""
 
-    def __init__(self, sock: socket.socket, stage: int) -> None:
+    def __init__(self, sock: Any, stage: int) -> None:
         super().__init__()
-        self._sock = sock
-        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._rbuf = b""
+        self._fs = sock if isinstance(sock, FramedSocket) \
+            else FramedSocket(sock)
         self.stage = stage
 
     # -- connection setup ------------------------------------------------
@@ -223,51 +222,22 @@ class SocketEndpoint(_TaggedReceiver):
         cls, port: int, stage: int, host: str = "127.0.0.1",
         timeout: float = _RECV_TIMEOUT_S,
     ) -> "SocketEndpoint":
-        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
-        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        srv.bind((host, port))
-        srv.listen(1)
-        srv.settimeout(timeout)
-        conn, _addr = srv.accept()
-        srv.close()
-        return cls(conn, stage)
+        return cls(FramedSocket.listen(port, host=host, timeout=timeout),
+                   stage)
 
     @classmethod
     def connect(
         cls, host: str, port: int, stage: int,
         timeout: float = _RECV_TIMEOUT_S,
     ) -> "SocketEndpoint":
-        deadline = time.perf_counter() + timeout
-        while True:
-            try:
-                sock = socket.create_connection((host, port), timeout=5.0)
-                return cls(sock, stage)
-            except OSError:
-                if time.perf_counter() > deadline:
-                    raise
-                time.sleep(0.05)
+        return cls(FramedSocket.connect(host, port, timeout=timeout), stage)
 
     # -- framing ---------------------------------------------------------
     def send(self, dst: int, tag: Any, value: Any) -> None:
-        payload = pickle.dumps(
-            (self.stage, tag, np.asarray(value)),
-            protocol=pickle.HIGHEST_PROTOCOL,
-        )
-        self._sock.sendall(struct.pack("!I", len(payload)) + payload)
-
-    def _read_exact(self, n: int, timeout: float) -> bytes:
-        self._sock.settimeout(timeout)
-        while len(self._rbuf) < n:
-            chunk = self._sock.recv(65536)
-            if not chunk:
-                raise ConnectionError("peer closed the pipeline transport")
-            self._rbuf += chunk
-        out, self._rbuf = self._rbuf[:n], self._rbuf[n:]
-        return out
+        self._fs.send_obj((self.stage, tag, np.asarray(value)))
 
     def _next(self, src: int, timeout: float) -> Tuple[Any, Any]:
-        (n,) = struct.unpack("!I", self._read_exact(4, timeout))
-        frame_src, tag, value = pickle.loads(self._read_exact(n, timeout))
+        frame_src, tag, value = self._fs.recv_obj(timeout)
         if frame_src != src:
             raise ValueError(
                 f"stage {self.stage} expected frames from {src}, "
@@ -276,10 +246,7 @@ class SocketEndpoint(_TaggedReceiver):
         return tag, jnp.asarray(value)
 
     def close(self) -> None:
-        try:
-            self._sock.close()
-        except OSError:
-            pass
+        self._fs.close()
 
 
 # ---------------------------------------------------------------------------

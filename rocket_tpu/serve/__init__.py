@@ -5,10 +5,18 @@ fault-free bit-equality contract; ``docs/reliability.md`` ("Serving
 reliability") for the operator view.
 """
 
+from rocket_tpu.serve.autoscale import (
+    Autoscaler,
+    AutoscaleCounters,
+    SLOPolicy,
+    register_fleet_source,
+    successive_halving_capacity,
+)
 from rocket_tpu.serve.fleet import PrefillReplica, Replica
 from rocket_tpu.serve.kvstore import (
     PrefixKVStore,
     PrefixMatch,
+    SharedPrefixIndex,
     page_hashes,
     register_kvstore_source,
 )
@@ -23,6 +31,7 @@ from rocket_tpu.serve.policy import (
     DegradationLevel,
     DegradationPolicy,
 )
+from rocket_tpu.serve.procfleet import ProcReplica
 from rocket_tpu.serve.queue import AdmissionQueue
 from rocket_tpu.serve.router import FleetRouter
 from rocket_tpu.serve.types import (
@@ -36,9 +45,12 @@ from rocket_tpu.serve.types import (
     Result,
 )
 from rocket_tpu.serve.watchdog import DispatchWatchdog
+from rocket_tpu.serve.wire import WorkerSpec
 
 __all__ = [
     "AdmissionQueue",
+    "Autoscaler",
+    "AutoscaleCounters",
     "Completed",
     "DEFAULT_LADDER",
     "DeadlineExceeded",
@@ -53,13 +65,19 @@ __all__ = [
     "PrefillReplica",
     "PrefixKVStore",
     "PrefixMatch",
+    "ProcReplica",
     "Replica",
     "ReplicaId",
     "Request",
     "Result",
+    "SLOPolicy",
     "ServeCounters",
     "ServeLatency",
     "ServingLoop",
+    "SharedPrefixIndex",
+    "WorkerSpec",
     "page_hashes",
+    "register_fleet_source",
     "register_kvstore_source",
+    "successive_halving_capacity",
 ]

@@ -1,0 +1,298 @@
+"""Goodput-driven autoscaling — capacity decisions from the metrics pane.
+
+The autoscaler closes the loop that the observability stack (PR 11) and
+the fleet router (PR 9) left open: every shed, latency percentile, and
+goodput bucket is already exported through
+:func:`rocket_tpu.observe.export.collect`; this module POLLS that
+surface against an SLO policy and turns breaches into fleet mutations —
+:meth:`FleetRouter.add_replica` on sustained overload,
+:meth:`FleetRouter.remove_replica` when the fleet runs cold.
+
+Signal discipline (why two different signal shapes):
+
+- **Scale-up** triggers on a *windowed* shed rate (delta of the fleet's
+  ``shed_saturated`` counter over delta ``submitted`` between polls) OR
+  a TTFT p95 breach.  Counters are cumulative, so raw ratios dilute a
+  live overload with the whole run's history; the delta window sees the
+  overload NOW.
+- **Scale-down** triggers on the *instantaneous* fleet load gauge, not
+  on latency: cumulative percentiles never decay within a run, so a
+  long-quiet fleet would look forever-breached by its one bad burst.
+
+Both directions require ``breach_rounds`` consecutive agreeing polls
+and honour independent cooldowns, so one noisy scrape never flaps the
+fleet.  Every decision lands in :class:`AutoscaleCounters`, registered
+as an export source — scale-ups are visible on the same ``/metrics``
+endpoint that triggered them.
+
+:func:`successive_halving_capacity` is the offline companion: pick an
+initial fleet size by racing candidate capacities under a doubling
+measurement budget (the same rung discipline as
+``tune/search.successive_halving``, without the tune-space coupling).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from rocket_tpu.observe import export
+
+__all__ = [
+    "SLOPolicy",
+    "AutoscaleCounters",
+    "Autoscaler",
+    "register_fleet_source",
+    "successive_halving_capacity",
+]
+
+
+@dataclass
+class SLOPolicy:
+    """The serving SLO plus the knobs that turn breaches into actions.
+
+    ``ttft_p95_ms`` / ``max_shed_rate`` define the SLO; everything else
+    shapes the control loop: floors/ceilings on fleet size, consecutive
+    breach polls required before acting, per-direction cooldowns, and
+    the cold-fleet threshold (mean in-flight load per replica) below
+    which capacity drains."""
+
+    ttft_p95_ms: float = 500.0
+    max_shed_rate: float = 0.05
+    min_replicas: int = 1
+    max_replicas: int = 4
+    breach_rounds: int = 2
+    scale_up_cooldown_s: float = 3.0
+    scale_down_cooldown_s: float = 10.0
+    drain_below_load: float = 0.25
+
+
+class AutoscaleCounters:
+    """Decision ledger, exported via ``register_source`` so every spawn
+    and drain is explicable from the scrape that shows the breach."""
+
+    def __init__(self) -> None:
+        self.polls = 0
+        self.breach_ttft = 0
+        self.breach_shed = 0
+        self.scale_ups = 0
+        self.scale_downs = 0
+        self.held_cooldown = 0
+        self.held_ceiling = 0
+        self.held_floor = 0
+        self.spawn_failures = 0
+        self.last_decision = 0      # +1 scaled up, -1 drained, 0 held
+        self.target_replicas = 0
+
+    def snapshot(self) -> Dict[str, float]:
+        return {
+            "polls": float(self.polls),
+            "breach_ttft": float(self.breach_ttft),
+            "breach_shed": float(self.breach_shed),
+            "scale_ups": float(self.scale_ups),
+            "scale_downs": float(self.scale_downs),
+            "held_cooldown": float(self.held_cooldown),
+            "held_ceiling": float(self.held_ceiling),
+            "held_floor": float(self.held_floor),
+            "spawn_failures": float(self.spawn_failures),
+            "last_decision": float(self.last_decision),
+            "target_replicas": float(self.target_replicas),
+        }
+
+
+def register_fleet_source(router: Any,
+                          name: str = "serve_fleet") -> None:
+    """Hang the fleet's live view on the export registry: router
+    counters, the merged fleet-wide latency percentiles, and the
+    instantaneous capacity gauges the autoscaler's down-trigger reads."""
+
+    def _snapshot() -> Dict[str, float]:
+        out = dict(router.snapshot())
+        out.update(router.latency().summary())
+        reps = list(router.replicas)
+        out["replicas"] = float(len(reps))
+        out["replicas_retiring"] = float(len(router._retiring))
+        out["load"] = float(sum(max(0, int(rep.load)) for rep in reps
+                                if rep.load < (1 << 29)))
+        return out
+
+    export.register_source(name, _snapshot)
+
+
+class Autoscaler:
+    """Poll the export surface, compare against the SLO, mutate the
+    fleet.
+
+    ``spawn_fn(replica_id) -> replica`` is the capacity factory — for a
+    process fleet it builds a :class:`~rocket_tpu.serve.procfleet.
+    ProcReplica` from a :class:`~rocket_tpu.serve.wire.WorkerSpec`
+    (elastic-restoring from the snapshot root on the way up); tests
+    hand in thread-backed replicas.  The autoscaler never constructs
+    replicas itself, so policy and mechanism stay separable.
+
+    Drive it with :meth:`step` from whatever beat the caller already
+    has (the demo calls it between burst pumps); it is deliberately NOT
+    self-threading — capacity changes should happen between serving
+    rounds, where the router's lock discipline expects them."""
+
+    def __init__(self, router: Any,
+                 spawn_fn: Callable[[str], Any],
+                 policy: Optional[SLOPolicy] = None, *,
+                 source: str = "serve_fleet",
+                 collect_fn: Callable[[], Dict[str, float]] = export.collect,
+                 clock: Callable[[], float] = time.monotonic,
+                 logger: Optional[logging.Logger] = None) -> None:
+        self.router = router
+        self.policy = policy or SLOPolicy()
+        self.counters = AutoscaleCounters()
+        self._spawn_fn = spawn_fn
+        self._source = source
+        self._collect = collect_fn
+        self._clock = clock
+        self._log = logger or logging.getLogger("rocket_tpu.autoscale")
+        self._spawned = 0
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_up_at = -float("inf")
+        self._last_down_at = -float("inf")
+        self._prev_shed: Optional[float] = None
+        self._prev_submitted: Optional[float] = None
+        self.events: List[Dict[str, Any]] = []
+        export.register_source("autoscaler", self.counters.snapshot)
+
+    # -- signal extraction ---------------------------------------------
+
+    def _shed_rate(self, metrics: Dict[str, float]) -> float:
+        """Windowed fleet shed rate: counter deltas between this poll
+        and the previous one (cumulative ratios would dilute a live
+        overload with the run's quiet history)."""
+        shed = metrics.get(f"{self._source}/shed_saturated", 0.0)
+        submitted = metrics.get(f"{self._source}/submitted", 0.0)
+        prev_shed, prev_sub = self._prev_shed, self._prev_submitted
+        self._prev_shed, self._prev_submitted = shed, submitted
+        if prev_shed is None or submitted <= prev_sub:
+            return 0.0
+        return (shed - prev_shed) / (submitted - prev_sub)
+
+    def _breached(self, metrics: Dict[str, float]) -> bool:
+        breach = False
+        ttft_p95 = metrics.get(f"{self._source}/ttft_ms/p95", 0.0)
+        if ttft_p95 > self.policy.ttft_p95_ms:
+            self.counters.breach_ttft += 1
+            breach = True
+        if self._shed_rate(metrics) > self.policy.max_shed_rate:
+            self.counters.breach_shed += 1
+            breach = True
+        return breach
+
+    # -- the control beat ----------------------------------------------
+
+    def step(self) -> int:
+        """One poll → at most one fleet mutation.  Returns +1 on scale
+        up, -1 on scale down, 0 on hold."""
+        metrics = self._collect()
+        self.counters.polls += 1
+        n = len(self.router.replicas)
+        self.counters.target_replicas = n
+        now = self._clock()
+
+        if self._breached(metrics):
+            self._up_streak += 1
+            self._down_streak = 0
+        else:
+            self._up_streak = 0
+            load = metrics.get(f"{self._source}/load", 0.0)
+            if n > 0 and load / n < self.policy.drain_below_load:
+                self._down_streak += 1
+            else:
+                self._down_streak = 0
+
+        decision = 0
+        if self._up_streak >= self.policy.breach_rounds:
+            decision = self._try_scale_up(now)
+        elif self._down_streak >= self.policy.breach_rounds:
+            decision = self._try_scale_down(now)
+        self.counters.last_decision = decision
+        return decision
+
+    def _try_scale_up(self, now: float) -> int:
+        if len(self.router.replicas) >= self.policy.max_replicas:
+            self.counters.held_ceiling += 1
+            return 0
+        if now - self._last_up_at < self.policy.scale_up_cooldown_s:
+            self.counters.held_cooldown += 1
+            return 0
+        self._spawned += 1
+        rid = f"scale-{self._spawned}"
+        try:
+            rep = self._spawn_fn(rid)
+            self.router.add_replica(rep)
+        except Exception as exc:
+            self.counters.spawn_failures += 1
+            self._log.warning("autoscale: spawn %s failed: %r", rid, exc)
+            return 0
+        self._last_up_at = now
+        self._up_streak = 0
+        self.counters.scale_ups += 1
+        self.counters.target_replicas = len(self.router.replicas)
+        self.events.append({"t": now, "action": "scale_up", "replica": rid})
+        self._log.info("autoscale: scaled up -> %s (%d replicas)",
+                       rid, len(self.router.replicas))
+        return 1
+
+    def _try_scale_down(self, now: float) -> int:
+        reps = list(self.router.replicas)
+        if len(reps) <= self.policy.min_replicas:
+            self.counters.held_floor += 1
+            return 0
+        if now - self._last_down_at < self.policy.scale_down_cooldown_s:
+            self.counters.held_cooldown += 1
+            return 0
+        # retire the least-loaded live replica: cheapest drain, and a
+        # sick one is the supervisor's problem (heal), not capacity's
+        live = [r for r in reps if r._dead is None]
+        victim = min(live or reps, key=lambda r: (int(r.load), str(r.replica_id)))
+        try:
+            self.router.remove_replica(victim.replica_id)
+        except ValueError:
+            self.counters.held_floor += 1
+            return 0
+        self._last_down_at = now
+        self._down_streak = 0
+        self.counters.scale_downs += 1
+        self.counters.target_replicas = len(self.router.replicas)
+        self.events.append({"t": now, "action": "scale_down",
+                            "replica": victim.replica_id})
+        self._log.info("autoscale: draining %s (%d replicas remain)",
+                       victim.replica_id, len(self.router.replicas))
+        return -1
+
+
+def successive_halving_capacity(
+    candidates: Sequence[int],
+    measure_fn: Callable[[int, int], float], *,
+    budget0: int = 1,
+    eta: int = 2,
+) -> int:
+    """Pick an initial fleet size by successive halving: race every
+    candidate capacity under a small measurement budget, keep the best
+    ``1/eta`` fraction, multiply the budget by ``eta``, repeat until one
+    survives.  ``measure_fn(capacity, budget) -> cost`` (lower is
+    better — e.g. p95 TTFT from a scaled probe burst); total measurement
+    spend is ``O(len(candidates) * budget0 * log(len(candidates)))``
+    rather than full-budget-per-candidate.  Same rung discipline as
+    ``tune/search.successive_halving``, decoupled from the tune space."""
+    alive = sorted(set(int(c) for c in candidates))
+    if not alive:
+        raise ValueError("no candidate capacities")
+    budget = max(1, int(budget0))
+    while len(alive) > 1:
+        scored = sorted(
+            ((measure_fn(cap, budget), cap) for cap in alive),
+            key=lambda pair: (pair[0], pair[1]))
+        keep = max(1, len(alive) // eta)
+        alive = sorted(cap for _, cap in scored[:keep])
+        budget *= eta
+    return alive[0]

@@ -183,6 +183,18 @@ class Replica:
                 self._outstanding.pop(res.rid, None)
         return results
 
+    def drain(self) -> None:
+        """Flip the loop to DRAINING: queued + in-flight work finishes,
+        new submits are refused.  The router's retire path calls this so
+        an autoscaler scale-down never drops accepted requests."""
+        if self._dead is not None:
+            return
+        with self._lock:
+            try:
+                self.loop.drain()
+            except Exception as exc:
+                self._dead = f"drain failed: {exc!r}"
+
     # -- self-healing --------------------------------------------------
 
     def heal(self) -> Tuple[List[Any], List[Request]]:

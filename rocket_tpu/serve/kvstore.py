@@ -52,6 +52,7 @@ from rocket_tpu.observe.trace import get_tracer
 __all__ = [
     "PrefixKVStore",
     "PrefixMatch",
+    "SharedPrefixIndex",
     "page_hashes",
     "register_kvstore_source",
 ]
@@ -154,6 +155,9 @@ class PrefixKVStore:
         self.evictions = 0
         self.evicted_bytes = 0
         self.rejected = 0
+        # hashes stored since the last drain — the delta a process-backed
+        # replica ships to the fleet's SharedPrefixIndex each step
+        self._fresh: List[bytes] = []
 
     def __len__(self) -> int:
         return len(self._table)
@@ -259,6 +263,7 @@ class PrefixKVStore:
                     self.inserts += 1
                     new += 1
                     stored.append(h)
+                    self._fresh.append(h)
             finally:
                 for entry in own:
                     if entry.pins > 0:
@@ -307,6 +312,15 @@ class PrefixKVStore:
                 "store per batcher layout"
             )
 
+    def drain_new_hashes(self) -> List[bytes]:
+        """Return-and-clear the hashes stored since the last drain.  A
+        worker process ships this delta in each STEP reply so the
+        supervisor's :class:`SharedPrefixIndex` learns which replica
+        holds which prefix without the pages ever crossing."""
+        with self._lock:
+            out, self._fresh = self._fresh, []
+        return out
+
     # -- observability -------------------------------------------------
 
     def snapshot(self) -> Dict[str, float]:
@@ -330,6 +344,100 @@ class PrefixKVStore:
                 "capacity_bytes": float(self.capacity_bytes),
                 "pages": float(len(self._table)),
                 "pinned": float(pinned),
+            }
+
+
+class SharedPrefixIndex:
+    """The prefix-cache HASH index shared across replica processes — the
+    routing half of the store, without the pages.
+
+    Each replica's :class:`PrefixKVStore` lives in its own process; only
+    the chain hashes it stores cross back to the supervisor
+    (:meth:`PrefixKVStore.drain_new_hashes` → the STEP reply), which
+    :meth:`note`\\ s them here.  The router then asks
+    :meth:`best_replica` for the replica holding the longest cached
+    chain of a new prompt — route-by-pages across process boundaries.
+
+    Correctness model: a HINT, exactly like session affinity.  The index
+    may be stale (the page was evicted, the replica died); the consumer
+    replica's own store lookup decides what is actually reusable, and a
+    wrong hint only costs a cold prefill.  :meth:`invalidate` drops a
+    replica's claims on heal/respawn — the rebuilt process starts with
+    an empty store, so every stale claim must go at once."""
+
+    def __init__(self, *, page_tokens: int = 16) -> None:
+        if page_tokens < 1:
+            raise ValueError(
+                f"page_tokens must be >= 1, got {page_tokens}")
+        self.page_tokens = int(page_tokens)
+        self._lock = threading.Lock()
+        self._where: Dict[bytes, set] = {}
+        self.notes = 0
+        self.queries = 0
+        self.routed = 0
+        self.invalidations = 0
+
+    def __len__(self) -> int:
+        return len(self._where)
+
+    def note(self, replica_id: Any, hashes: Iterable[bytes]) -> None:
+        with self._lock:
+            for h in hashes:
+                self._where.setdefault(h, set()).add(replica_id)
+                self.notes += 1
+
+    def invalidate(self, replica_id: Any) -> int:
+        """Drop every claim a replica holds (its process respawned with
+        an empty store).  Returns the number of claims dropped."""
+        with self._lock:
+            dropped = 0
+            dead = []
+            for h, holders in self._where.items():
+                if replica_id in holders:
+                    holders.discard(replica_id)
+                    dropped += 1
+                    if not holders:
+                        dead.append(h)
+            for h in dead:
+                del self._where[h]
+            if dropped:
+                self.invalidations += 1
+            return dropped
+
+    def best_replica(self, tokens) -> Optional[Any]:
+        """The replica holding the longest cached page chain of
+        ``tokens`` (ties broken by sorted id for determinism), or
+        ``None`` on a total miss.  Walks the chain keeping the replicas
+        that hold EVERY page so far — a chain with a hole is unreachable
+        past it, same rule as the store's own walk."""
+        toks = np.asarray(tokens, np.int32).reshape(-1)
+        hashes = page_hashes(toks, self.page_tokens,
+                             limit=toks.shape[0] - 1)
+        with self._lock:
+            self.queries += 1
+            survivors: Optional[set] = None
+            for h in hashes:
+                holders = self._where.get(h)
+                if not holders:
+                    break
+                nxt = set(holders) if survivors is None \
+                    else survivors & holders
+                if not nxt:
+                    break
+                survivors = nxt
+            if not survivors:
+                return None
+            self.routed += 1
+            return sorted(survivors, key=str)[0]
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "pages": float(len(self._where)),
+                "notes": float(self.notes),
+                "queries": float(self.queries),
+                "routed": float(self.routed),
+                "invalidations": float(self.invalidations),
             }
 
 

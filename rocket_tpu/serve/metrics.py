@@ -125,6 +125,9 @@ class FleetCounters:
         self.deadline_shed_prefill = 0  # deadline passed in the prefill lane
         self.affinity_routed = 0    # session requests routed to their replica
         self.affinity_invalidated = 0   # session stamps dropped by a heal
+        self.pages_routed = 0       # routed by the shared prefix-hash index
+        self.replicas_added = 0     # autoscaler spawns joined to the fleet
+        self.replicas_retired = 0   # replicas drained out of the fleet
 
     def snapshot(self) -> Dict[str, float]:
         return {
@@ -138,4 +141,7 @@ class FleetCounters:
             "deadline_shed_prefill": float(self.deadline_shed_prefill),
             "affinity_routed": float(self.affinity_routed),
             "affinity_invalidated": float(self.affinity_invalidated),
+            "pages_routed": float(self.pages_routed),
+            "replicas_added": float(self.replicas_added),
+            "replicas_retired": float(self.replicas_retired),
         }

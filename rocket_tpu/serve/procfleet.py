@@ -1,0 +1,368 @@
+"""Process-backed serving replicas — the fleet's units become real OS
+processes.
+
+:class:`ProcReplica` implements the exact router-facing surface of
+:class:`~rocket_tpu.serve.fleet.Replica` (``submit`` / ``pump`` /
+``drain_results`` / ``probe`` / ``heal`` / ``load`` / ``health`` /
+``start`` / ``stop`` / ``close``), backed by a spawned worker subprocess
+running ``python -m rocket_tpu.serve.worker``.  A
+:class:`~rocket_tpu.serve.router.FleetRouter` drives it unchanged: the
+supervisor-side rid→Request shadow (``_outstanding``) is the salvage
+source of truth, so a worker that dies UNREADABLE — ``kill -9``, OOM, a
+segfaulting extension — still resolves every accepted request to exactly
+one typed result (results the worker produced but never shipped died
+with it unobserved; the salvaged request's re-route emits the one).
+
+Spawn rendezvous: the supervisor binds an ephemeral port
+(:class:`~rocket_tpu.utils.framing.FrameListener`), passes it on the
+worker's command line, accepts the connection, ships the
+:class:`~rocket_tpu.serve.wire.WorkerSpec`, and waits for READY.  The
+spec names a module-level builder — not a pickled closure — so the
+worker builds (or elastic-restores) its own weights; seeded jax init
+makes the fault-free fleet bit-equal to an in-process oracle.
+
+RPC model: strictly one-in-flight request/reply under a lock.  ``pump``
+is one STEP RPC = one serving round on the worker; the reply carries the
+round's typed results, load, health, the worker's latency histograms
+(snapshot-replaced, so the router's fleet merge never double-counts),
+and the prefix-store hash delta for the shared routing index.  Any
+socket error or timeout marks the replica dead; supervision heals it by
+killing whatever is left of the process and respawning.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from rocket_tpu.serve import wire
+from rocket_tpu.serve.metrics import ServeLatency
+from rocket_tpu.serve.types import HealthState, ReplicaId, Request
+from rocket_tpu.utils.framing import FrameListener
+
+LOG = logging.getLogger("rocket_tpu.serve.fleet")
+
+
+class ProcReplica:
+    """One decode-lane replica served by a worker subprocess.
+
+    ``spec`` is the :class:`~rocket_tpu.serve.wire.WorkerSpec` shipped to
+    every (re)spawn — heal rebuilds the replica from it the way
+    ``Replica.heal`` rebuilds from its loop factory.  ``prefix_index``
+    (a :class:`~rocket_tpu.serve.kvstore.SharedPrefixIndex`) learns the
+    worker's stored page hashes from each STEP reply and is invalidated
+    wholesale on heal.  ``kill()`` SIGKILLs the worker — the chaos hook:
+    nothing supervisor-side is notified, exactly like a real host loss.
+    """
+
+    def __init__(self, spec: wire.WorkerSpec, replica_id: ReplicaId, *,
+                 python: Optional[str] = None,
+                 spawn_timeout_s: float = 120.0,
+                 rpc_timeout_s: float = 120.0,
+                 probe_timeout_s: float = 30.0,
+                 clock: Callable[[], float] = time.monotonic,
+                 prefix_index: Optional[Any] = None,
+                 env: Optional[Dict[str, str]] = None,
+                 logger: Optional[logging.Logger] = None) -> None:
+        self.replica_id = replica_id
+        self._spec = spec
+        self._python = python if python is not None else sys.executable
+        self._spawn_timeout = float(spawn_timeout_s)
+        self._rpc_timeout = float(rpc_timeout_s)
+        self._probe_timeout = float(probe_timeout_s)
+        self._clock = clock
+        self._prefix_index = prefix_index
+        self._env = env
+        self._log = logger if logger is not None else LOG
+        self._dead: Optional[str] = None
+        self._lock = threading.RLock()
+        # rid -> Request for every request the worker accepted and has
+        # not yet answered — the salvage source of truth, readable even
+        # when the process is a corpse (the whole point of this layer).
+        self._outstanding: Dict[Any, Request] = {}
+        self._results: List[Any] = []
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        # caches refreshed by each RPC reply — property reads never RPC
+        self._load = 0
+        self._health = HealthState.SERVING
+        self.latency = ServeLatency()
+        self.counters: Dict[str, float] = {}
+        self.spawns = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self._fs = None
+        self._spawn()
+
+    # -- process lifecycle ---------------------------------------------
+
+    def _spawn(self) -> None:
+        listener = FrameListener(0)
+        try:
+            cmd = [
+                self._python, "-m", "rocket_tpu.serve.worker",
+                "--connect", f"127.0.0.1:{listener.port}",
+                "--replica-id", str(self.replica_id),
+            ]
+            env = dict(os.environ)
+            if self._env:
+                env.update(self._env)
+            self.proc = subprocess.Popen(cmd, env=env)
+            self._fs = listener.accept(timeout=self._spawn_timeout)
+        finally:
+            listener.close()
+        wire.send_msg(self._fs, wire.HELLO, self._spec)
+        kind, payload = wire.recv_msg(self._fs, self._spawn_timeout)
+        if kind == wire.ERROR:
+            raise RuntimeError(
+                f"replica {self.replica_id}: worker failed to build:\n"
+                f"{payload}")
+        if kind != wire.READY:
+            raise RuntimeError(
+                f"replica {self.replica_id}: expected READY, got {kind!r}")
+        self.spawns += 1
+        self._load = 0
+        self._health = HealthState.SERVING
+        self.latency = ServeLatency()
+        self._log.info("fleet: replica %s worker pid=%s up (%s devices)",
+                       self.replica_id, payload.get("pid"),
+                       payload.get("devices"))
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self.proc.pid if self.proc is not None else None
+
+    def kill(self) -> None:
+        """SIGKILL the worker — the chaos hook.  No supervisor-side state
+        changes: the death must be DISCOVERED by probe/pump, exactly like
+        a real unannounced host loss."""
+        if self.proc is not None and self.proc.poll() is None:
+            os.kill(self.proc.pid, signal.SIGKILL)
+
+    def _reap(self) -> None:
+        if self.proc is not None:
+            try:
+                if self.proc.poll() is None:
+                    self.proc.kill()
+                self.proc.wait(timeout=10.0)
+            except Exception:
+                pass
+        if self._fs is not None:
+            self._fs.close()
+            self._fs = None
+
+    # -- RPC ------------------------------------------------------------
+
+    def _rpc(self, kind: str, payload: Any = None,
+             timeout: Optional[float] = None) -> Optional[Any]:
+        """One request/reply; ``None`` marks this replica dead (the
+        router's supervision beat picks the salvage up from there)."""
+        if self._dead is not None:
+            return None
+        with self._lock:
+            try:
+                wire.send_msg(self._fs, kind, payload)
+                rkind, reply = wire.recv_msg(
+                    self._fs, timeout if timeout is not None
+                    else self._rpc_timeout)
+            except Exception as exc:
+                self._log.warning("fleet: replica %s died: %r",
+                                  self.replica_id, exc)
+                self._dead = f"{kind} rpc failed: {exc!r}"
+                return None
+            if rkind == wire.ERROR:
+                self._dead = f"worker error on {kind}: {reply}"
+                return None
+            return reply
+
+    # -- router-facing surface -----------------------------------------
+
+    @property
+    def health(self) -> HealthState:
+        if self._dead is not None:
+            return HealthState.DRAINING
+        return self._health
+
+    @property
+    def load(self) -> int:
+        if self._dead is not None:
+            return 1 << 30
+        return self._load
+
+    def probe(self) -> bool:
+        """Active liveness: the corpse check (``proc.poll()``) catches a
+        kill -9 without burning an RPC timeout; a live process must also
+        answer PING within the probe budget."""
+        if self._dead is not None:
+            return False
+        if self._thread is not None and not self._thread.is_alive() \
+                and self._stop is not None and not self._stop.is_set():
+            self._dead = "driver thread died"
+            return False
+        if self.proc is None or self.proc.poll() is not None:
+            rc = self.proc.poll() if self.proc is not None else None
+            self._dead = f"worker process exited rc={rc}"
+            return False
+        reply = self._rpc(wire.PING, timeout=self._probe_timeout)
+        if reply is None:
+            return False
+        self._load = int(reply.get("load", self._load))
+        try:
+            self._health = HealthState(reply["health"])
+        except (KeyError, ValueError):
+            pass
+        return True
+
+    def submit(self, req: Request) -> bool:
+        if self._dead is not None:
+            return False
+        # corpse check first: submitting into a dead pipe would burn the
+        # RPC timeout per request during the window before supervision
+        if self.proc is None or self.proc.poll() is not None:
+            self._dead = f"worker process exited rc={self.proc.poll()}" \
+                if self.proc is not None else "no worker process"
+            return False
+        reply = self._rpc(wire.SUBMIT,
+                          wire.pack_request(req, clock=self._clock))
+        if reply is None or not reply.get("accepted"):
+            return False
+        with self._lock:
+            self._outstanding[req.rid] = req
+            self._load = int(reply.get("load", self._load))
+        return True
+
+    def pump(self) -> bool:
+        """One STEP RPC = one serving round on the worker."""
+        if self._dead is not None:
+            return False
+        reply = self._rpc(wire.STEP)
+        if reply is None:
+            return False
+        with self._lock:
+            self._results.extend(reply.get("results", ()))
+            self._load = int(reply.get("load", 0))
+            try:
+                self._health = HealthState(reply["health"])
+            except (KeyError, ValueError):
+                pass
+            latency = reply.get("latency")
+            if latency is not None:
+                # snapshot-REPLACE (not merge): the worker ships its own
+                # cumulative histograms each step
+                self.latency = latency
+            self.counters = reply.get("counters", self.counters)
+        hashes = reply.get("kv_hashes")
+        if hashes and self._prefix_index is not None:
+            self._prefix_index.note(self.replica_id, hashes)
+        return bool(reply.get("busy"))
+
+    def drain_results(self) -> List[Any]:
+        with self._lock:
+            out, self._results = self._results, []
+            for res in out:
+                self._outstanding.pop(res.rid, None)
+        return out
+
+    def drain(self) -> None:
+        """Stop the worker admitting new requests (autoscaler retire)."""
+        self._rpc(wire.DRAIN)
+
+    # -- self-healing ---------------------------------------------------
+
+    def heal(self) -> Tuple[List[Any], List[Request]]:
+        """Kill-and-respawn: reap whatever is left of the worker, settle
+        the shadow (results already shipped are final; everything else
+        salvages), drop this replica's prefix-index claims, and spawn a
+        fresh worker from the same spec.  Every request this replica
+        ever accepted appears in exactly one of the returned lists."""
+        was_threaded = self._thread is not None
+        self._stop_thread()
+        self._reap()
+        with self._lock:
+            final = list(self._results)
+            self._results = []
+            for res in final:
+                self._outstanding.pop(res.rid, None)
+            salvaged = list(self._outstanding.values())
+            self._outstanding.clear()
+        for req in salvaged:
+            # the handoff's pages died with the worker; re-prefill
+            if getattr(req, "_handoff", None) is not None:
+                req._handoff = None
+        if self._prefix_index is not None:
+            # the respawned worker starts with an EMPTY store — every
+            # claim the dead one registered is stale at once
+            self._prefix_index.invalidate(self.replica_id)
+        # respawn BEFORE clearing the death flag (same ordering rule as
+        # Replica.heal: submit gates on _dead then uses the transport).
+        # A failed respawn leaves the replica dead — salvage already
+        # happened, and the next supervision beat retries the spawn.
+        try:
+            self._spawn()
+        except Exception as exc:
+            self._reap()
+            self._dead = f"respawn failed: {exc!r}"
+            self._log.warning("fleet: replica %s respawn failed: %r",
+                              self.replica_id, exc)
+            return final, salvaged
+        self._dead = None
+        if was_threaded:
+            self.start()
+        return final, salvaged
+
+    # -- threading ------------------------------------------------------
+
+    @property
+    def threaded(self) -> bool:
+        return self._thread is not None
+
+    def start(self, idle_s: float = 0.001) -> None:
+        """Driver thread pumping STEP rounds — same closure-captured stop
+        event discipline as ``Replica.start``."""
+        if self._thread is not None:
+            return
+        stop = threading.Event()
+
+        def drive() -> None:
+            while not stop.is_set():
+                if self._dead is not None:
+                    stop.wait(idle_s)
+                    continue
+                busy = self.pump()
+                if not busy:
+                    stop.wait(idle_s)
+
+        self._stop = stop
+        self._thread = threading.Thread(
+            target=drive, name=f"procreplica-{self.replica_id}",
+            daemon=True)
+        self._thread.start()
+
+    def _stop_thread(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        self._stop = None
+
+    def stop(self) -> None:
+        self._stop_thread()
+
+    def close(self) -> None:
+        """Orderly teardown: stop the driver, ask the worker to exit
+        (collecting any final results it still holds), then reap."""
+        self._stop_thread()
+        if self._dead is None and self._fs is not None:
+            reply = self._rpc(wire.SHUTDOWN, timeout=10.0)
+            if reply is not None:
+                with self._lock:
+                    self._results.extend(reply.get("results", ()))
+        self._reap()
+        if self._dead is None:
+            self._dead = "closed"

@@ -75,6 +75,7 @@ class FleetRouter:
                  clock: Callable[[], float] = time.monotonic,
                  tracer: Optional[Any] = None,
                  recorder: Optional[Any] = None,
+                 prefix_index: Optional[Any] = None,
                  logger: Optional[logging.Logger] = None) -> None:
         if not replicas:
             raise ValueError("FleetRouter needs at least one replica")
@@ -84,11 +85,18 @@ class FleetRouter:
         self._clock = clock
         self._tracer = tracer
         self._recorder = recorder
+        # SharedPrefixIndex (or None): route-by-pages across replica
+        # processes — a routing HINT fed by each replica's stored page
+        # hashes, invalidated wholesale when a replica is healed
+        self._prefix_index = prefix_index
         self._log = logger if logger is not None else LOG
         self.counters = FleetCounters()
         self._lock = threading.RLock()
         self._results: List[Any] = []
         self._retry: List[Request] = []
+        # replicas removed from routing but still draining in-flight
+        # work; pumped/supervised until idle, then closed and dropped
+        self._retiring: List[Any] = []
         # session key -> decode replica that served the session's last
         # turn (and so holds its prefix pages); pruned on heal
         self._affinity: Dict[Any, ReplicaId] = {}
@@ -141,6 +149,7 @@ class FleetRouter:
                     if r.health is HealthState.DEGRADED]
         candidates = self._least_loaded(serving) + self._least_loaded(degraded)
         sticky_id = None
+        pages_id = None
         if req.session is not None:
             sticky_id = self._affinity.get(req.session)
             if sticky_id is not None:
@@ -150,12 +159,25 @@ class FleetRouter:
                     # if busier; a refusal falls back to least-loaded
                     candidates = sticky + [r for r in candidates
                                            if r.replica_id != sticky_id]
+        if sticky_id is None and self._prefix_index is not None \
+                and getattr(req, "_handoff", None) is None:
+            # route-by-pages: the shared hash index knows which replica
+            # process holds the longest cached chain of this prompt —
+            # same hint semantics as affinity (refusal falls back)
+            pages_id = self._prefix_index.best_replica(req.prompt)
+            if pages_id is not None:
+                hinted = [r for r in candidates if r.replica_id == pages_id]
+                if hinted:
+                    candidates = hinted + [r for r in candidates
+                                           if r.replica_id != pages_id]
         for rep in candidates:
             if rep.submit(req):
                 affine = req.session is not None \
                     and rep.replica_id == sticky_id
                 if affine:
                     self.counters.affinity_routed += 1
+                if pages_id is not None and rep.replica_id == pages_id:
+                    self.counters.pages_routed += 1
                 if req.session is not None:
                     self._affinity[req.session] = rep.replica_id
                 self._instant("fleet/route", rid=req.rid, lane="decode",
@@ -198,7 +220,8 @@ class FleetRouter:
         """Probe every replica, heal the failed ones, re-route salvaged
         and retry-pending requests.  Returns the number of heals."""
         heals = 0
-        for rep in list(self.replicas) + list(self.prefill_replicas):
+        for rep in (list(self.replicas) + list(self._retiring)
+                    + list(self.prefill_replicas)):
             if rep.probe():
                 continue
             heals += 1
@@ -228,6 +251,15 @@ class FleetRouter:
             if stale:
                 self._instant("fleet/affinity_invalidated",
                               replica=rep.replica_id, sessions=len(stale))
+            if self._prefix_index is not None:
+                # ProcReplica.heal already invalidated (its respawned
+                # worker starts empty); in-process replicas keep their
+                # host-side store across a rebuild, but in-flight claims
+                # are unverifiable — drop them too, the hint re-learns
+                dropped = self._prefix_index.invalidate(rep.replica_id)
+                if dropped:
+                    self._instant("fleet/pages_invalidated",
+                                  replica=rep.replica_id, pages=dropped)
         if self._tracer is not None:
             self._tracer.counter("fleet/heals", self.counters.heals,
                                  replica=rep.replica_id)
@@ -262,19 +294,36 @@ class FleetRouter:
         for rep in self.prefill_replicas:
             if not rep.threaded:
                 rep.pump()
-        for rep in self.replicas:
+        for rep in list(self.replicas) + list(self._retiring):
             if not rep.threaded:
                 rep.pump()
         self.collect()
+        self._sweep_retired()
         return self.busy
 
     def collect(self) -> None:
         """Sweep every replica's typed results into the router's."""
-        for rep in self.replicas:
+        for rep in list(self.replicas) + list(self._retiring):
             results = rep.drain_results()
             if results:
                 with self._lock:
                     self._results.extend(results)
+
+    def _sweep_retired(self) -> None:
+        """Close and drop retiring replicas that finished draining."""
+        done = [rep for rep in self._retiring
+                if rep._dead is None and rep.load == 0
+                and not rep._outstanding]
+        for rep in done:
+            with self._lock:
+                self._retiring.remove(rep)
+            self.counters.replicas_retired += 1
+            self._instant("fleet/replica_retired", replica=rep.replica_id)
+            self._log.info("fleet: retired replica %s", rep.replica_id)
+            try:
+                rep.close()
+            except Exception:
+                pass
 
     @property
     def busy(self) -> bool:
@@ -282,7 +331,7 @@ class FleetRouter:
             return True
         if any(rep.load > 0 for rep in self.prefill_replicas):
             return True
-        for rep in self.replicas:
+        for rep in list(self.replicas) + list(self._retiring):
             if rep._dead is not None:
                 # a threaded replica can die BETWEEN this pump's
                 # supervise and this check; its outstanding requests
@@ -303,7 +352,8 @@ class FleetRouter:
         for _ in range(max_rounds):
             busy = self.pump()
             if all(rep.threaded
-                   for rep in self.replicas + self.prefill_replicas):
+                   for rep in (self.replicas + self._retiring
+                               + self.prefill_replicas)):
                 # all work happens on driver threads — pumping is just
                 # supervision, so pace it instead of busy-waiting
                 time.sleep(idle_s)
@@ -325,6 +375,58 @@ class FleetRouter:
             out, self._results = self._results, []
         return out
 
+    # -- capacity elasticity -------------------------------------------
+
+    def add_replica(self, rep: Any, *, start: Optional[bool] = None) -> None:
+        """Join a replica to the decode lane mid-flight (the autoscaler's
+        spawn path).  ``start=None`` thread-backs it iff the existing
+        fleet is threaded, so one driving mode governs the whole fleet."""
+        with self._lock:
+            ids = [r.replica_id for r in self.replicas] \
+                + [r.replica_id for r in self._retiring] \
+                + [r.replica_id for r in self.prefill_replicas]
+            if rep.replica_id in ids:
+                raise ValueError(
+                    f"duplicate replica id: {rep.replica_id!r}")
+            if start is None:
+                start = any(r.threaded for r in self.replicas)
+            self.replicas.append(rep)
+            self.counters.replicas_added += 1
+        self._instant("fleet/replica_added", replica=rep.replica_id)
+        self._log.info("fleet: added replica %s", rep.replica_id)
+        if start:
+            rep.start()
+
+    def remove_replica(self, replica_id: ReplicaId) -> Any:
+        """Retire a replica from routing (the autoscaler's drain path):
+        it stops receiving new requests immediately, keeps draining its
+        queued + in-flight work under supervision, and is closed once
+        idle.  Its session stamps drop so turns re-route freely."""
+        with self._lock:
+            if len(self.replicas) <= 1:
+                raise ValueError("cannot retire the last decode replica")
+            matches = [r for r in self.replicas
+                       if r.replica_id == replica_id]
+            if not matches:
+                raise ValueError(f"no decode replica {replica_id!r}")
+            rep = matches[0]
+            self.replicas.remove(rep)
+            self._retiring.append(rep)
+            stale = [k for k, v in self._affinity.items()
+                     if v == replica_id]
+            for k in stale:
+                del self._affinity[k]
+                self.counters.affinity_invalidated += 1
+        if self._prefix_index is not None:
+            self._prefix_index.invalidate(replica_id)
+        try:
+            rep.drain()
+        except Exception:
+            pass  # a dying replica drains via heal/salvage instead
+        self._instant("fleet/replica_retiring", replica=replica_id)
+        self._log.info("fleet: retiring replica %s", replica_id)
+        return rep
+
     # -- lifecycle / observability -------------------------------------
 
     def start(self, idle_s: float = 0.001) -> None:
@@ -333,22 +435,30 @@ class FleetRouter:
             rep.start(idle_s)
 
     def stop(self) -> None:
-        for rep in list(self.prefill_replicas) + list(self.replicas):
+        for rep in (list(self.prefill_replicas) + list(self.replicas)
+                    + list(self._retiring)):
             rep.stop()
 
     def close(self) -> None:
-        for rep in list(self.prefill_replicas) + list(self.replicas):
+        for rep in (list(self.prefill_replicas) + list(self.replicas)
+                    + list(self._retiring)):
             rep.close()
 
     def latency(self) -> ServeLatency:
         """Fleet-wide latency view: every decode replica's histograms
-        merged into a fresh ``ServeLatency`` (replica state untouched)."""
+        merged into a fresh ``ServeLatency`` (replica state untouched).
+        Thread-backed replicas expose ``loop.latency`` directly; a
+        process-backed replica's ``latency`` attribute is the snapshot
+        its worker shipped with the last STEP reply."""
         agg = ServeLatency()
-        for rep in self.replicas:
+        for rep in list(self.replicas) + list(self._retiring):
             try:
                 agg.merge(rep.loop.latency)
             except Exception:
-                pass
+                try:
+                    agg.merge(rep.latency)
+                except Exception:
+                    pass
         return agg
 
     def snapshot(self) -> Dict[str, float]:

@@ -1,0 +1,138 @@
+"""Wire protocol between a fleet supervisor and a serving worker process.
+
+One message is one length-prefixed frame (:mod:`rocket_tpu.utils.framing`
+— the same bytes as the MPMD pipeline transport) holding a pickled
+``(kind, payload)`` tuple.  Everything that crosses is host data: typed
+results carry numpy token buffers, and a :class:`~rocket_tpu.models.
+generate.KVHandoff` travels via :meth:`~KVHandoff.to_host` — its stated
+wire format — so neither side ever pickles a device array.
+
+The RPC discipline is strictly one-in-flight request/reply, supervisor
+side initiating: the supervisor sends ``SUBMIT``/``STEP``/``PING``/...,
+the worker answers with exactly one reply frame (``ERROR`` on an escaped
+exception).  That keeps the worker single-threaded and makes "the socket
+went quiet" an unambiguous death signal for the supervisor's probe.
+
+Deadlines cross as REMAINING seconds: ``Request.deadline`` is absolute
+on the submitting clock, which a different process does not share —
+:func:`pack_request` subtracts the local clock, :func:`unpack_request`
+re-anchors on the worker's, so a salvaged request re-routed to another
+process keeps exactly its remaining budget.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+from rocket_tpu.serve.types import Request
+from rocket_tpu.utils.framing import FramedSocket
+
+# -- message kinds -----------------------------------------------------------
+
+HELLO = "hello"          # supervisor -> worker: the WorkerSpec
+READY = "ready"          # worker -> supervisor: loop built, serving
+SUBMIT = "submit"        # packed request -> {"accepted": bool, "load": int}
+STEP = "step"            # run one round -> results/busy/load/health/...
+PING = "ping"            # liveness probe -> PONG with load/health
+PONG = "pong"
+DRAIN = "drain"          # stop admitting; in-flight work finishes
+COLLECT = "collect"      # counters + latency snapshot (no round)
+SHUTDOWN = "shutdown"    # orderly exit -> BYE, then the process exits
+BYE = "bye"
+REPLY = "reply"          # generic success reply
+ERROR = "error"          # worker -> supervisor: payload is the repr
+
+
+def send_msg(fs: FramedSocket, kind: str, payload: Any = None) -> None:
+    fs.send_obj((kind, payload))
+
+
+def recv_msg(fs: FramedSocket, timeout: float) -> Tuple[str, Any]:
+    msg = fs.recv_obj(timeout)
+    if not (isinstance(msg, tuple) and len(msg) == 2):
+        raise ValueError(f"malformed wire message: {type(msg)!r}")
+    return msg
+
+
+# -- worker spec -------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class WorkerSpec:
+    """Everything a worker process needs to build its ServingLoop.
+
+    ``builder`` is a DOTTED reference (``"module.path:function"``) to a
+    module-level callable returning a ServingLoop — a reference, not a
+    pickled closure, so the spec crosses to a fresh interpreter that
+    imports and calls it (seeded jax init being deterministic, two
+    processes building the same spec hold bit-identical weights).
+    ``kwargs`` must be plain picklable data.  ``restore_dir`` arms the
+    elastic-restore path: the builder restores params from the newest
+    valid snapshot under it (validated by ``check_reshard`` against
+    whatever devices this worker got) instead of seeding them.
+    """
+
+    builder: str
+    kwargs: Optional[Dict[str, Any]] = None
+    restore_dir: Optional[str] = None
+
+    def resolve(self) -> Callable[..., Any]:
+        mod_name, sep, attr = self.builder.partition(":")
+        if not sep:
+            raise ValueError(
+                f"builder must be 'module:function', got {self.builder!r}")
+        fn = getattr(importlib.import_module(mod_name), attr, None)
+        if not callable(fn):
+            raise ValueError(f"builder {self.builder!r} is not callable")
+        return fn
+
+    def build(self) -> Any:
+        kwargs = dict(self.kwargs or {})
+        if self.restore_dir is not None:
+            kwargs["restore_dir"] = self.restore_dir
+        return self.resolve()(**kwargs)
+
+
+# -- request / result packing ------------------------------------------------
+
+
+def pack_request(req: Request, *,
+                 clock: Callable[[], float] = time.monotonic
+                 ) -> Dict[str, Any]:
+    """Request -> plain wire dict (deadline as remaining seconds, any
+    prefilled handoff as host numpy)."""
+    out: Dict[str, Any] = {
+        "rid": req.rid,
+        "prompt": np.asarray(req.prompt, np.int32),
+        "remaining": None if req.deadline is None
+        else float(req.deadline) - clock(),
+        "max_new_tokens": req.max_new_tokens,
+        "beam": bool(req.beam),
+        "session": req.session,
+    }
+    handoff = getattr(req, "_handoff", None)
+    if handoff is not None:
+        out["handoff"] = handoff.to_host()
+    return out
+
+
+def unpack_request(wire: Dict[str, Any], *,
+                   clock: Callable[[], float] = time.monotonic) -> Request:
+    req = Request(
+        rid=wire["rid"],
+        prompt=wire["prompt"],
+        deadline=None if wire.get("remaining") is None
+        else clock() + float(wire["remaining"]),
+        max_new_tokens=wire.get("max_new_tokens"),
+        beam=bool(wire.get("beam", False)),
+        session=wire.get("session"),
+    )
+    handoff = wire.get("handoff")
+    if handoff is not None:
+        req._handoff = handoff
+    return req

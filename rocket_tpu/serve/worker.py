@@ -1,0 +1,177 @@
+"""Serving worker — the subprocess half of a process-backed replica.
+
+``python -m rocket_tpu.serve.worker --connect HOST:PORT --replica-id ID``
+connects back to the supervisor that spawned it (the supervisor binds an
+ephemeral port FIRST, so the rendezvous never races), receives a
+:class:`~rocket_tpu.serve.wire.WorkerSpec`, builds its ServingLoop from
+the spec's dotted builder reference — restoring weights through the
+elastic-restore gate when the spec names a snapshot root — and then
+answers the one-in-flight RPC stream: ``SUBMIT`` offers a request
+(side-effect-free refusal, the router owns the typed result), ``STEP``
+runs one serving round and ships every typed result produced so far,
+``PING`` answers liveness, ``SHUTDOWN`` exits cleanly.
+
+Death model: this process holds NO salvage responsibility.  The
+supervisor's :class:`~rocket_tpu.serve.procfleet.ProcReplica` shadows
+every accepted request; results this worker produced but never shipped
+die with it, which is exactly what keeps the exactly-once contract — an
+unshipped result was never observed, so the salvaged request's re-route
+emits the single one.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+import traceback
+from typing import Any, Optional
+
+from rocket_tpu.serve import wire
+from rocket_tpu.utils.framing import FramedSocket, parse_address
+
+_HELLO_TIMEOUT_S = 120.0
+# Idle RPC wait: the supervisor drives a beat at least every probe
+# interval; a socket quiet for this long means the supervisor is gone
+# and the worker should die with it rather than leak.
+_IDLE_TIMEOUT_S = 600.0
+
+
+def restore_params(restore_dir: str, targets: Any) -> Any:
+    """Elastic-restore a ``params`` tree from the newest valid snapshot
+    under ``restore_dir`` onto whatever devices THIS process got.
+
+    The PR 13 gate runs first: :func:`~rocket_tpu.persist.integrity.
+    check_reshard` validates every target leaf (shape, mesh-axis names,
+    spec rank) against the snapshot's mesh-stamped manifest, so a worker
+    spawned onto an incompatible topology fails loudly with the remedy
+    instead of serving mis-placed weights."""
+    from rocket_tpu.persist import integrity
+    from rocket_tpu.persist.orbax_io import CheckpointIO
+
+    path = integrity.latest_valid(restore_dir, do_quarantine=False)
+    if path is None:
+        path = integrity.resolve_restore_path(restore_dir,
+                                              do_quarantine=False)
+    if path is None:
+        raise FileNotFoundError(
+            f"no valid snapshot under {restore_dir!r} to restore from")
+    manifest = integrity.read_manifest(path)
+    if manifest is not None:
+        integrity.check_reshard(manifest, {"params": targets})
+    io = CheckpointIO(use_async=False)
+    try:
+        return io.restore(path, targets={"params": targets})["params"]
+    finally:
+        io.close()
+
+
+def serve(fs: FramedSocket, loop: Any, *,
+          clock=time.monotonic) -> int:
+    """Answer the supervisor's RPC stream until SHUTDOWN or socket loss.
+
+    Every request gets exactly one reply frame; an exception escaping a
+    handler answers ``ERROR`` (the supervisor declares this replica dead
+    and salvages from its shadow)."""
+    kvstore = getattr(loop, "kvstore", None)
+    while True:
+        try:
+            kind, payload = wire.recv_msg(fs, _IDLE_TIMEOUT_S)
+        except (ConnectionError, OSError, TimeoutError):
+            return 1    # supervisor gone — die with it
+        try:
+            if kind == wire.SUBMIT:
+                req = wire.unpack_request(payload, clock=clock)
+                handoff = getattr(req, "_handoff", None)
+                if handoff is not None:
+                    rej = loop.submit_prefilled(req, handoff,
+                                                record_rejection=False)
+                else:
+                    rej = loop.submit(req, record_rejection=False)
+                wire.send_msg(fs, wire.REPLY, {
+                    "accepted": rej is None, "load": int(loop.load)})
+            elif kind == wire.STEP:
+                ran = bool(loop.run_round())
+                reply = {
+                    "results": loop.drain_results(),
+                    "busy": ran or int(loop.load) > 0,
+                    "load": int(loop.load),
+                    "health": loop.health.value,
+                    "latency": loop.latency,
+                    "counters": loop.counters.snapshot(),
+                }
+                if kvstore is not None:
+                    reply["kv_hashes"] = kvstore.drain_new_hashes()
+                wire.send_msg(fs, wire.REPLY, reply)
+            elif kind == wire.PING:
+                wire.send_msg(fs, wire.PONG, {
+                    "load": int(loop.load),
+                    "health": loop.health.value,
+                    "pid": os.getpid(),
+                })
+            elif kind == wire.DRAIN:
+                loop.drain()
+                wire.send_msg(fs, wire.REPLY, {"health": loop.health.value})
+            elif kind == wire.COLLECT:
+                wire.send_msg(fs, wire.REPLY, {
+                    "counters": loop.counters.snapshot(),
+                    "latency": loop.latency,
+                })
+            elif kind == wire.SHUTDOWN:
+                wire.send_msg(fs, wire.BYE, {"results": loop.drain_results()})
+                try:
+                    loop.close()
+                except Exception:
+                    pass
+                return 0
+            else:
+                wire.send_msg(fs, wire.ERROR, f"unknown message {kind!r}")
+        except (ConnectionError, OSError):
+            return 1
+        except Exception as exc:
+            try:
+                wire.send_msg(fs, wire.ERROR, repr(exc))
+            except Exception:
+                return 1
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="rocket_tpu serving worker (spawned by ProcReplica)")
+    parser.add_argument("--connect", required=True, metavar="HOST:PORT",
+                        help="supervisor rendezvous address")
+    parser.add_argument("--replica-id", default=None,
+                        help="fleet identity stamped on every result")
+    args = parser.parse_args(argv)
+
+    host, port = parse_address(args.connect)
+    fs = FramedSocket.connect(host, port)
+    try:
+        kind, spec = wire.recv_msg(fs, _HELLO_TIMEOUT_S)
+        if kind != wire.HELLO or not isinstance(spec, wire.WorkerSpec):
+            wire.send_msg(fs, wire.ERROR,
+                          f"expected HELLO WorkerSpec, got {kind!r}")
+            return 2
+        try:
+            loop = spec.build()
+            if args.replica_id is not None:
+                loop.replica_id = args.replica_id
+                loop.queue.name = args.replica_id
+        except Exception:
+            wire.send_msg(fs, wire.ERROR, traceback.format_exc())
+            return 2
+        import jax
+
+        wire.send_msg(fs, wire.READY, {
+            "pid": os.getpid(),
+            "devices": int(jax.local_device_count()),
+            "platform": jax.default_backend(),
+        })
+        return serve(fs, loop)
+    finally:
+        fs.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -27,7 +27,10 @@ Three fault families, matching how TPU training actually dies:
   graceful drain-and-rebuild path), and :class:`SlowPrefillInjector`
   stretches long-prompt prefills on a ``ContinuousBatcher`` (the
   deterministic stand-in for the prefill cost the prefill/decode lane
-  split exists to absorb).
+  split exists to absorb), and :class:`ProcessKillInjector` SIGKILLs a
+  process-backed replica's worker on a scheduled pump tick (the REAL
+  kill -9 the in-process injectors only imitate — drives
+  ``ProcReplica``'s corpse-discovery + shadow-salvage path).
 
 Everything here is deterministic (iteration- or call-indexed, never
 random) so chaos tests replay exactly.
@@ -263,6 +266,41 @@ class FlakyReplicaProxy:
             object.__setattr__(self, name, value)
         else:
             setattr(self._loop, name, value)
+
+
+class ProcessKillInjector:
+    """SIGKILL a process-backed replica's worker on a scheduled tick.
+
+    ``tick()`` is the injector's clock — the chaos driver calls it once
+    per pump beat, and on the tick indexes in ``kill_on`` (0 = first
+    tick) the injector sends SIGKILL to the replica's CURRENT worker
+    pid via ``ProcReplica.kill()``.  kill -9 is the point: no atexit, no
+    socket shutdown handshake, no flushed results — the supervisor must
+    discover the corpse from a failed RPC or a ``proc.poll()`` and
+    salvage from its request shadow.  Deterministic (tick-indexed, never
+    random), same discipline as every injector here; a respawned worker
+    after a heal gets a NEW pid, so scheduling two ticks kills the
+    replica twice.
+    """
+
+    def __init__(self, replica: Any, kill_on: Iterable[int] = (0,)) -> None:
+        self._replica = replica
+        self._kill_on = set(int(i) for i in kill_on)
+        self.ticks = 0   # tick() calls seen
+        self.kills = 0   # SIGKILLs actually delivered
+
+    def tick(self) -> bool:
+        """Advance the chaos clock; returns True if this tick killed."""
+        pos = self.ticks
+        self.ticks += 1
+        if pos not in self._kill_on:
+            return False
+        try:
+            self._replica.kill()
+        except (ProcessLookupError, OSError):
+            return False    # already a corpse — nothing to kill
+        self.kills += 1
+        return True
 
 
 class SlowPrefillInjector:

@@ -1,0 +1,129 @@
+"""Module-level worker builders for process-backed fleet tests & demos.
+
+A :class:`~rocket_tpu.serve.wire.WorkerSpec` carries a DOTTED reference
+to a builder, not a pickled closure — the worker process imports this
+module and calls the named function.  Everything here is therefore
+importable at module level, takes only plain-data kwargs, and builds
+the SAME tiny transformer pair the fleet tests use in-process
+(``tests/test_fleet.py``): seeded jax init is deterministic, so a
+worker building ``build_tiny_loop()`` holds weights bit-identical to
+the parent process's oracle — bit-equality crosses the process boundary
+without ever shipping a parameter.
+
+``restore_dir`` flips the builder from seed-init to elastic restore:
+params come from the newest valid snapshot under the root, through the
+:func:`~rocket_tpu.serve.worker.restore_params` gate
+(``check_reshard`` against whatever devices the worker got).
+:func:`save_tiny_snapshot` writes such a snapshot — with a DIFFERENT
+seed than the builder default, a test proves the restore actually
+happened by matching the snapshot-seed oracle, not the default one.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+# Tiny CPU-proxy sizes — identical to tests/test_fleet.py so the
+# in-process oracle and the subprocess worker agree bit-for-bit.
+VOCAB, HIDDEN, LAYERS, HEADS, MAX_SEQ = 64, 32, 2, 4, 64
+B, P, TOTAL, NDRAFT = 3, 8, 24, 4
+SEED_TARGET, SEED_DRAFT = 1, 7
+
+
+def tiny_models(seed_target: int = SEED_TARGET,
+                seed_draft: int = SEED_DRAFT) -> Tuple[Any, Any, Any, Any]:
+    """``(model, draft, params, dparams)`` — same structure for both,
+    different seeds so speculative acceptance stays partial."""
+    import jax
+
+    from rocket_tpu.models.transformer import TransformerConfig, TransformerLM
+
+    def _init(seed: int):
+        cfg = TransformerConfig(vocab_size=VOCAB, hidden=HIDDEN,
+                                n_layers=LAYERS, n_heads=HEADS,
+                                max_seq=MAX_SEQ)
+        m = TransformerLM(cfg)
+        p = m.init(
+            jax.random.PRNGKey(seed),
+            {"tokens": np.zeros((1, P), np.int32),
+             "positions": np.zeros((1, P), np.int32)},
+        )["params"]
+        return m, p
+
+    model, params = _init(seed_target)
+    draft, _ = _init(seed_target)       # same structure...
+    _, dparams = _init(seed_draft)      # ...different weights
+    return model, draft, params, dparams
+
+
+def build_tiny_loop(
+    *,
+    max_batch: int = B,
+    queue_capacity: int = 16,
+    seed_target: int = SEED_TARGET,
+    seed_draft: int = SEED_DRAFT,
+    restore_dir: Optional[str] = None,
+    kvstore_page_tokens: Optional[int] = None,
+    watchdog_timeout: Optional[float] = None,
+) -> Any:
+    """The WorkerSpec builder: a fresh ServingLoop over the tiny pair.
+
+    ``restore_dir`` replaces the seed-initialised target params with an
+    elastic restore from the newest valid snapshot under it (the seeded
+    tree doubles as the ``check_reshard`` target template).
+    ``kvstore_page_tokens`` arms a per-process prefix cache whose new
+    page hashes ship to the supervisor's shared index on every STEP."""
+    from rocket_tpu.models.generate import ContinuousBatcher
+    from rocket_tpu.serve.kvstore import PrefixKVStore
+    from rocket_tpu.serve.loop import ServingLoop
+
+    model, draft, params, dparams = tiny_models(seed_target, seed_draft)
+    if restore_dir is not None:
+        from rocket_tpu.serve.worker import restore_params
+
+        params = restore_params(restore_dir, params)
+
+    def factory():
+        return ContinuousBatcher(
+            model, draft, params, dparams,
+            total_len=TOTAL, n_draft=NDRAFT, eos_token=None,
+        )
+
+    kvstore = None
+    if kvstore_page_tokens is not None:
+        kvstore = PrefixKVStore(page_tokens=int(kvstore_page_tokens))
+    return ServingLoop(
+        factory,
+        max_batch=int(max_batch),
+        queue_capacity=int(queue_capacity),
+        watchdog_timeout=watchdog_timeout,
+        kvstore=kvstore,
+    )
+
+
+def save_tiny_snapshot(root: str, *, seed_target: int = SEED_TARGET) -> str:
+    """Write a committed, manifest-stamped params snapshot under
+    ``<root>/weights/000000`` — the layout ``integrity.latest_valid``
+    elects from — and return the snapshot path.  The manifest records
+    the saving mesh, so a restoring worker's ``check_reshard`` gate has
+    a topology to validate against."""
+    import jax
+
+    from rocket_tpu.persist import integrity
+    from rocket_tpu.persist.orbax_io import CheckpointIO
+
+    _, _, params, _ = tiny_models(seed_target=seed_target)
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()).reshape(-1), ("data",))
+    path = os.path.join(os.path.abspath(root), "weights", "000000")
+    manifest = integrity.build_manifest(
+        {"params": params}, iter_idx=0, mesh=mesh)
+    io = CheckpointIO(use_async=False)
+    try:
+        io.save(path, {"params": params}, manifest=manifest, wait=True)
+    finally:
+        io.close()
+    return path

@@ -1,0 +1,142 @@
+"""Length-prefixed TCP framing — the shared wire discipline.
+
+One frame is ``struct.pack("!I", len(payload)) + payload``; a reader
+pulls exactly four bytes of length, then exactly that many bytes of
+payload, buffering partial ``recv`` chunks in between.  This is the
+framing :class:`~rocket_tpu.parallel.mpmd.SocketEndpoint` proved for
+pipeline activation transport, factored out so the serving fleet's wire
+protocol (:mod:`rocket_tpu.serve.wire`) speaks the same bytes — one
+transport discipline, two protocols on top.
+
+- :class:`FramedSocket` wraps one connected TCP socket: ``send_bytes`` /
+  ``recv_bytes`` move raw frames, ``send_obj`` / ``recv_obj`` add
+  highest-protocol pickling (both sides are our own processes — the
+  same trust model as mpmd's pickled ndarray frames).
+- :class:`FrameListener` splits bind-and-accept: a parent can bind an
+  ephemeral port, READ the port number, spawn a child that connects to
+  it, and only then accept — the rendezvous a spawned worker subprocess
+  needs (``SocketEndpoint.listen`` keeps its one-shot bind+accept shape
+  on top of this).
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import time
+from typing import Any, Optional, Tuple
+
+DEFAULT_TIMEOUT_S = 120.0
+
+_LEN = struct.Struct("!I")
+
+
+class FramedSocket:
+    """One connected TCP socket carrying length-prefixed frames."""
+
+    def __init__(self, sock: socket.socket) -> None:
+        self._sock = sock
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._rbuf = b""
+
+    # -- connection setup ------------------------------------------------
+
+    @classmethod
+    def listen(
+        cls, port: int, host: str = "127.0.0.1",
+        timeout: float = DEFAULT_TIMEOUT_S,
+    ) -> "FramedSocket":
+        """Bind, accept ONE peer, return its framed socket (the listener
+        closes — point-to-point transport, not a server)."""
+        listener = FrameListener(port, host=host)
+        try:
+            return listener.accept(timeout)
+        finally:
+            listener.close()
+
+    @classmethod
+    def connect(
+        cls, host: str, port: int, timeout: float = DEFAULT_TIMEOUT_S,
+    ) -> "FramedSocket":
+        """Connect with retry — the peer may still be binding."""
+        deadline = time.perf_counter() + timeout
+        while True:
+            try:
+                sock = socket.create_connection((host, port), timeout=5.0)
+                return cls(sock)
+            except OSError:
+                if time.perf_counter() > deadline:
+                    raise
+                time.sleep(0.05)
+
+    # -- framing ---------------------------------------------------------
+
+    def send_bytes(self, payload: bytes) -> None:
+        self._sock.sendall(_LEN.pack(len(payload)) + payload)
+
+    def _read_exact(self, n: int, timeout: float) -> bytes:
+        self._sock.settimeout(timeout)
+        while len(self._rbuf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("peer closed the framed transport")
+            self._rbuf += chunk
+        out, self._rbuf = self._rbuf[:n], self._rbuf[n:]
+        return out
+
+    def recv_bytes(self, timeout: float = DEFAULT_TIMEOUT_S) -> bytes:
+        (n,) = _LEN.unpack(self._read_exact(_LEN.size, timeout))
+        return self._read_exact(n, timeout)
+
+    # -- pickled objects -------------------------------------------------
+
+    def send_obj(self, obj: Any) -> None:
+        self.send_bytes(pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL))
+
+    def recv_obj(self, timeout: float = DEFAULT_TIMEOUT_S) -> Any:
+        return pickle.loads(self.recv_bytes(timeout))
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class FrameListener:
+    """A bound-but-not-yet-accepted rendezvous point.
+
+    ``port=0`` lets the OS pick; read :attr:`port` before spawning the
+    peer, then :meth:`accept` its connection."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1") -> None:
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(1)
+        self.host = host
+        self.port = int(self._srv.getsockname()[1])
+
+    def accept(self, timeout: float = DEFAULT_TIMEOUT_S) -> FramedSocket:
+        self._srv.settimeout(timeout)
+        conn, _addr = self._srv.accept()
+        return FramedSocket(conn)
+
+    def close(self) -> None:
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+
+def address(host: str, port: int) -> str:
+    return f"{host}:{port}"
+
+
+def parse_address(addr: str) -> Tuple[str, int]:
+    """``"host:port"`` -> ``(host, port)`` (the worker CLI format)."""
+    host, sep, port = addr.rpartition(":")
+    if not sep or not port.isdigit():
+        raise ValueError(f"expected HOST:PORT, got {addr!r}")
+    return host or "127.0.0.1", int(port)

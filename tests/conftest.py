@@ -68,6 +68,14 @@ def pytest_configure(config):
         "page hashing, LRU eviction, cached-prefix bit-equality, session "
         "affinity; see docs/performance.md \"Prefix cache\")",
     )
+    config.addinivalue_line(
+        "markers",
+        "procfleet: process-backed fleet tests (rocket_tpu.serve "
+        "procfleet/wire/worker/autoscale — wire protocol, worker "
+        "subprocess, kill -9 salvage, goodput-driven autoscaling; see "
+        "docs/reliability.md \"Process fleet & autoscaling\"; the "
+        "full kill-mid-burst and autoscale bursts are slow)",
+    )
 
 
 # Fast-first ordering: the handful of files below carry the long
@@ -85,6 +93,7 @@ _HEAVY_TAIL = (
     "test_multi_optimizer.py",
     "test_ladder_shapes.py",
     "test_mpmd.py",
+    "test_procfleet.py",
 )
 
 

@@ -1,0 +1,539 @@
+"""Process-backed fleet tests — wire protocol, worker subprocess,
+kill -9 salvage, and goodput-driven autoscaling.
+
+Three layers:
+
+- units (no subprocess): the shared framing transport, request packing
+  with deadline re-anchoring, the shared prefix-hash index's
+  route-by-pages walk, and the autoscaler's decision logic against a
+  fake metrics feed (breach streaks, cooldowns, floors/ceilings);
+- one-worker smoke (tier-1, heavy tail): a real ``python -m
+  rocket_tpu.serve.worker`` subprocess serving bit-identical to the
+  in-process oracle — the exactly-once + bit-equality contract crossing
+  the process boundary;
+- chaos + elasticity (``slow``): SIGKILL mid-burst through the router
+  (exactly one typed result per request, salvaged included, respawned
+  worker serves bit-correct), autoscaler spawning/draining real worker
+  processes with decisions visible on the export surface, and a
+  respawn that elastic-restores from a snapshot root.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from rocket_tpu.observe import export
+from rocket_tpu.serve import (
+    Autoscaler,
+    Completed,
+    FleetRouter,
+    ProcReplica,
+    Request,
+    SharedPrefixIndex,
+    SLOPolicy,
+    WorkerSpec,
+    page_hashes,
+    register_fleet_source,
+    successive_halving_capacity,
+)
+from rocket_tpu.serve import wire
+from rocket_tpu.testing import workers as tw
+from rocket_tpu.testing.chaos import ProcessKillInjector
+from rocket_tpu.utils.framing import (
+    FramedSocket,
+    FrameListener,
+    parse_address,
+)
+
+pytestmark = pytest.mark.procfleet
+
+BUILDER = "rocket_tpu.testing.workers:build_tiny_loop"
+SPAWN_S = 240.0     # worker spawn includes a jax import + model init
+
+
+@pytest.fixture(scope="module")
+def prompts():
+    rng = np.random.default_rng(13)
+    return rng.integers(1, tw.VOCAB, size=(16, tw.P)).astype(np.int32)
+
+
+@pytest.fixture(scope="module")
+def oracle(prompts):
+    """rid-index -> expected tokens, from the single-loop oracle."""
+    from rocket_tpu.models.generate import speculative_generate_batched
+
+    model, draft, params, dparams = tw.tiny_models()
+
+    def _expect(i):
+        toks = speculative_generate_batched(
+            model, params, draft, dparams, prompts[i][None, :],
+            max_new_tokens=tw.TOTAL - tw.P, n_draft=tw.NDRAFT,
+        )
+        return np.asarray(toks[0])
+
+    return _expect
+
+
+@pytest.fixture(autouse=True)
+def _clean_export_sources():
+    yield
+    export.unregister_source("autoscaler")
+    export.unregister_source("serve_fleet")
+
+
+def _await_corpse(rep, timeout=10.0):
+    """SIGKILL delivery is asynchronous — wait for the pid to reap."""
+    deadline = time.monotonic() + timeout
+    while rep.proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert rep.proc.poll() is not None, "worker survived SIGKILL"
+
+
+def _assert_exactly_once(results, rids):
+    got = sorted(r.rid for r in results)
+    assert got == sorted(rids), (got, sorted(rids))
+
+
+def _pump_until_done(rep_or_router, want, max_rounds=400):
+    out = []
+    for _ in range(max_rounds):
+        busy = rep_or_router.pump()
+        out.extend(rep_or_router.drain_results())
+        if len(out) >= want and not busy:
+            return out
+    raise AssertionError(f"only {len(out)}/{want} results after "
+                         f"{max_rounds} rounds")
+
+
+# -- units: framing ----------------------------------------------------------
+
+
+def test_framing_roundtrip_and_peer_close():
+    listener = FrameListener(0)
+    client = FramedSocket.connect("127.0.0.1", listener.port)
+    server = listener.accept(timeout=10.0)
+    listener.close()
+    try:
+        client.send_obj({"a": np.arange(5), "b": "x"})
+        msg = server.recv_obj(10.0)
+        assert msg["b"] == "x" and np.array_equal(msg["a"], np.arange(5))
+        # a frame bigger than one recv() chunk crosses intact
+        blob = np.random.default_rng(0).bytes(1 << 20)
+        server.send_bytes(blob)
+        assert client.recv_bytes(10.0) == blob
+        server.close()
+        with pytest.raises(ConnectionError):
+            client.recv_bytes(5.0)
+    finally:
+        client.close()
+        server.close()
+
+
+def test_parse_address():
+    assert parse_address("127.0.0.1:8432") == ("127.0.0.1", 8432)
+    with pytest.raises(ValueError):
+        parse_address("no-port")
+
+
+# -- units: wire protocol ----------------------------------------------------
+
+
+def test_request_packing_reanchors_deadline():
+    req = Request(rid="w0", prompt=np.arange(8, dtype=np.int32),
+                  deadline=105.0, max_new_tokens=4, session="s1")
+    packed = wire.pack_request(req, clock=lambda: 100.0)
+    assert packed["remaining"] == pytest.approx(5.0)
+    # the receiving process has a completely different clock origin
+    got = wire.unpack_request(packed, clock=lambda: 7000.0)
+    assert got.rid == "w0" and got.session == "s1"
+    assert got.deadline == pytest.approx(7005.0)
+    assert got.max_new_tokens == 4
+    assert np.array_equal(got.prompt, req.prompt)
+    assert getattr(got, "_handoff", None) is None
+
+
+def test_request_packing_carries_handoff_host_form():
+    class FakeHandoff:
+        def to_host(self):
+            return {"pages": np.ones((2, 4), np.float32)}
+
+    req = Request(rid="w1", prompt=np.arange(8, dtype=np.int32))
+    req._handoff = FakeHandoff()
+    packed = wire.pack_request(req, clock=lambda: 0.0)
+    assert packed["remaining"] is None
+    assert isinstance(packed["handoff"], dict)
+    got = wire.unpack_request(packed, clock=lambda: 0.0)
+    assert np.array_equal(got._handoff["pages"],
+                          np.ones((2, 4), np.float32))
+
+
+def test_workerspec_resolve_rejects_bad_refs():
+    with pytest.raises(ValueError):
+        WorkerSpec(builder="not.a.module.function").resolve()
+    with pytest.raises(ValueError):
+        WorkerSpec(builder="os:no_such_function").resolve()
+    fn = WorkerSpec(builder=BUILDER).resolve()
+    assert callable(fn)
+
+
+# -- units: shared prefix-hash index -----------------------------------------
+
+
+def test_shared_prefix_index_routes_longest_chain():
+    idx = SharedPrefixIndex(page_tokens=4)
+    toks = np.arange(17, dtype=np.int32)
+    chain = page_hashes(toks, 4, limit=toks.shape[0] - 1)
+    assert len(chain) == 4
+    idx.note("a", chain[:2])        # holds pages 0-1
+    idx.note("b", chain)            # holds the whole chain
+    assert idx.best_replica(toks) == "b"
+    # a replica with a HOLE in the chain is unreachable past it
+    idx2 = SharedPrefixIndex(page_tokens=4)
+    idx2.note("c", [chain[0], chain[2]])
+    idx2.note("d", chain[:1])
+    assert idx2.best_replica(toks) in ("c", "d")  # both hold page 0 only
+    # total miss
+    assert SharedPrefixIndex(page_tokens=4).best_replica(toks) is None
+    # invalidation drops every claim at once
+    dropped = idx.invalidate("b")
+    assert dropped == 4
+    assert idx.best_replica(toks) == "a"
+    snap = idx.snapshot()
+    assert snap["invalidations"] == 1.0 and snap["queries"] >= 2.0
+
+
+def test_shared_prefix_index_tiebreak_deterministic():
+    idx = SharedPrefixIndex(page_tokens=4)
+    toks = np.arange(9, dtype=np.int32)
+    chain = page_hashes(toks, 4, limit=8)
+    idx.note("z", chain)
+    idx.note("a", chain)
+    assert idx.best_replica(toks) == "a"   # sorted-id tie-break
+
+
+# -- units: autoscaler decision logic ----------------------------------------
+
+
+class _FakeReplica:
+    def __init__(self, rid, load=0):
+        self.replica_id = rid
+        self.load = load
+        self._dead = None
+        self.threaded = False
+
+    def start(self, idle_s=0.001):
+        pass
+
+    def drain(self):
+        pass
+
+
+class _FakeRouter:
+    def __init__(self, n=1):
+        self.replicas = [_FakeReplica(f"r{i}") for i in range(n)]
+        self._retiring = []
+        self.added = []
+        self.removed = []
+
+    def add_replica(self, rep, *, start=None):
+        self.replicas.append(rep)
+        self.added.append(rep.replica_id)
+
+    def remove_replica(self, rid):
+        (rep,) = [r for r in self.replicas if r.replica_id == rid]
+        self.replicas.remove(rep)
+        self.removed.append(rid)
+        return rep
+
+
+def _scaler(router, metrics, policy, t):
+    return Autoscaler(
+        router, lambda rid: _FakeReplica(rid), policy,
+        collect_fn=lambda: dict(metrics), clock=lambda: t[0])
+
+
+def test_autoscaler_scales_up_on_ttft_breach_after_streak():
+    router = _FakeRouter(1)
+    metrics = {"serve_fleet/ttft_ms/p95": 900.0, "serve_fleet/load": 10.0,
+               "serve_fleet/submitted": 0.0,
+               "serve_fleet/shed_saturated": 0.0}
+    t = [0.0]
+    auto = _scaler(router, metrics, SLOPolicy(
+        ttft_p95_ms=500.0, breach_rounds=2, max_replicas=3,
+        scale_up_cooldown_s=0.0), t)
+    assert auto.step() == 0          # first breach: streak building
+    assert auto.step() == 1          # second consecutive breach: spawn
+    assert router.added == ["scale-1"]
+    assert auto.counters.scale_ups == 1
+    assert auto.counters.breach_ttft == 2
+    # ceiling holds even under a continuing breach
+    auto.policy.max_replicas = 2
+    assert auto.step() == 0 and auto.step() == 0
+    assert auto.counters.held_ceiling >= 1
+
+
+def test_autoscaler_shed_rate_is_windowed_not_cumulative():
+    router = _FakeRouter(1)
+    metrics = {"serve_fleet/ttft_ms/p95": 0.0, "serve_fleet/load": 10.0,
+               "serve_fleet/submitted": 1000.0,
+               "serve_fleet/shed_saturated": 100.0}
+    t = [0.0]
+    auto = _scaler(router, metrics, SLOPolicy(
+        ttft_p95_ms=1e9, max_shed_rate=0.05, breach_rounds=1,
+        scale_up_cooldown_s=0.0), t)
+    # first poll only seeds the window — a big CUMULATIVE shed count
+    # from history must not read as a live breach
+    assert auto.step() == 0
+    # no new sheds between polls: rate 0, still no breach
+    metrics["serve_fleet/submitted"] = 1100.0
+    assert auto.step() == 0
+    # 50 sheds out of 100 new submissions: a live 50% shed rate
+    metrics["serve_fleet/submitted"] = 1200.0
+    metrics["serve_fleet/shed_saturated"] = 150.0
+    assert auto.step() == 1
+    assert auto.counters.breach_shed == 1
+
+
+def test_autoscaler_cooldown_and_scale_down():
+    router = _FakeRouter(3)
+    router.replicas[0].load = 4     # the busy one
+    metrics = {"serve_fleet/ttft_ms/p95": 0.0, "serve_fleet/load": 0.2,
+               "serve_fleet/submitted": 0.0,
+               "serve_fleet/shed_saturated": 0.0}
+    t = [0.0]
+    auto = _scaler(router, metrics, SLOPolicy(
+        ttft_p95_ms=1e9, breach_rounds=1, min_replicas=1,
+        drain_below_load=0.5, scale_down_cooldown_s=100.0), t)
+    assert auto.step() == -1        # cold fleet: drain one
+    assert router.removed == ["r1"]  # least-loaded live replica, r0 busy
+    assert auto.step() == 0         # cooldown holds
+    assert auto.counters.held_cooldown == 1
+    t[0] = 200.0
+    assert auto.step() == -1        # cooldown elapsed
+    t[0] = 400.0
+    assert auto.step() == 0         # floor: never below min_replicas
+    assert auto.counters.held_floor == 1
+    assert len(router.replicas) == 1
+
+
+def test_autoscaler_registers_decisions_as_export_source():
+    router = _FakeRouter(1)
+    metrics = {"serve_fleet/ttft_ms/p95": 900.0, "serve_fleet/load": 1.0,
+               "serve_fleet/submitted": 0.0,
+               "serve_fleet/shed_saturated": 0.0}
+    t = [0.0]
+    auto = _scaler(router, metrics, SLOPolicy(
+        ttft_p95_ms=500.0, breach_rounds=1, scale_up_cooldown_s=0.0), t)
+    try:
+        auto.step()
+        snap = export.collect()
+        assert snap["autoscaler/scale_ups"] == 1.0
+        assert snap["autoscaler/polls"] == 1.0
+        assert "rocket_tpu_autoscaler_scale_ups" in export.prometheus_text()
+    finally:
+        export.unregister_source("autoscaler")
+
+
+def test_successive_halving_capacity_converges_cheaply():
+    calls = []
+
+    def measure(cap, budget):
+        calls.append((cap, budget))
+        return abs(cap - 4) + 1.0 / budget   # true optimum: 4 replicas
+
+    best = successive_halving_capacity(
+        [1, 2, 4, 8, 16, 32, 64, 128], measure, budget0=1, eta=2)
+    assert best == 4
+    # geometric rungs: 8 + 4 + 2 measurements, budgets doubling
+    assert len(calls) == 14
+    assert max(b for _, b in calls) == 4
+
+
+# -- one-worker smoke (tier-1 heavy tail) ------------------------------------
+
+
+def test_proc_worker_bit_equal_and_salvage(prompts, oracle):
+    """One real worker subprocess: results bit-identical to the
+    in-process oracle; kill -9 leaves every accepted request salvageable
+    from the supervisor shadow; a respawn serves again."""
+    spec = WorkerSpec(builder=BUILDER)
+    rep = ProcReplica(spec, "smoke-0", spawn_timeout_s=SPAWN_S,
+                      rpc_timeout_s=SPAWN_S)
+    try:
+        for i in range(2):
+            assert rep.submit(Request(rid=f"s{i}", prompt=prompts[i]))
+        assert rep.load == 2
+        results = _pump_until_done(rep, 2)
+        _assert_exactly_once(results, ["s0", "s1"])
+        for res in results:
+            assert isinstance(res, Completed)
+            i = int(res.rid[1:])
+            assert np.array_equal(np.asarray(res.tokens), oracle(i)), res.rid
+        assert not rep._outstanding
+
+        # kill -9: the corpse is discovered, nothing is lost
+        assert rep.submit(Request(rid="s2", prompt=prompts[2]))
+        rep.kill()
+        _await_corpse(rep)
+        assert not rep.probe()
+        assert rep.load == 1 << 30          # dead replicas repel routing
+        final, salvaged = rep.heal()        # respawns a fresh worker
+        assert [q.rid for q in salvaged] == ["s2"] and not final
+        assert rep.spawns == 2
+        assert rep.probe()
+        # the respawned worker serves bit-correct
+        assert rep.submit(salvaged[0])
+        (res,) = _pump_until_done(rep, 1)
+        assert isinstance(res, Completed)
+        assert np.array_equal(np.asarray(res.tokens), oracle(2))
+    finally:
+        rep.close()
+    assert rep._dead == "closed"
+
+
+# -- chaos + elasticity (slow) -----------------------------------------------
+
+
+@pytest.mark.slow
+def test_proc_fleet_kill9_mid_burst_exactly_once(prompts, oracle):
+    """Acceptance: SIGKILL one worker mid-burst through the router — the
+    fleet keeps serving, every request resolves to exactly one typed
+    result (salvaged included), the respawned replica serves
+    bit-correct.  Fault-free requests stay bit-equal to the oracle."""
+    spec = WorkerSpec(builder=BUILDER,
+                      kwargs={"kvstore_page_tokens": 4})
+    index = SharedPrefixIndex(page_tokens=4)
+    reps = [ProcReplica(spec, f"pf{i}", spawn_timeout_s=SPAWN_S,
+                        rpc_timeout_s=SPAWN_S, prefix_index=index)
+            for i in range(2)]
+    router = FleetRouter(reps, prefix_index=index)
+    injector = ProcessKillInjector(reps[0], kill_on=(2,))
+    rids = []
+    try:
+        for i in range(10):
+            req = Request(rid=f"k{i}", prompt=prompts[i % len(prompts)])
+            rids.append(req.rid)
+            router.submit(req)
+            injector.tick()      # tick #2 SIGKILLs pf0 mid-burst
+            router.pump()        # supervision discovers + heals inline
+        results = router.run_until_idle()
+        _assert_exactly_once(results, rids)
+        assert injector.kills == 1
+        assert reps[0].spawns == 2          # healed once
+        assert router.counters.heals == 1
+        assert router.counters.requeued >= 1
+        # worker stores shipped their page-hash deltas cross-process
+        assert index.snapshot()["notes"] > 0
+        for res in results:
+            assert isinstance(res, Completed), res
+            i = int(res.rid[1:]) % len(prompts)
+            assert np.array_equal(np.asarray(res.tokens), oracle(i)), res.rid
+    finally:
+        router.close()
+
+
+@pytest.mark.slow
+def test_autoscaler_spawns_and_drains_real_workers(prompts):
+    """Acceptance: the autoscaler spawns >= 1 worker process on an SLO
+    breach, drains one after load drops (retired replica closed once
+    idle), and its decisions are visible on the export surface."""
+    spec = WorkerSpec(builder=BUILDER)
+    rep0 = ProcReplica(spec, "auto-0", spawn_timeout_s=SPAWN_S,
+                       rpc_timeout_s=SPAWN_S)
+    router = FleetRouter([rep0])
+    register_fleet_source(router)
+    spawned = []
+
+    def spawn(rid):
+        rep = ProcReplica(spec, rid, spawn_timeout_s=SPAWN_S,
+                          rpc_timeout_s=SPAWN_S)
+        spawned.append(rep)
+        return rep
+
+    auto = Autoscaler(router, spawn, SLOPolicy(
+        ttft_p95_ms=1e-6, breach_rounds=1, max_replicas=2,
+        scale_up_cooldown_s=0.0, scale_down_cooldown_s=0.0,
+        drain_below_load=0.5))
+    try:
+        rids = []
+        for i in range(4):
+            rid = f"a{i}"
+            rids.append(rid)
+            router.submit(Request(rid=rid, prompt=prompts[i]))
+        results = router.run_until_idle()
+        # any served request breaches the absurd TTFT SLO -> scale up
+        while auto.counters.scale_ups == 0 and auto.counters.polls < 5:
+            auto.step()
+        assert auto.counters.scale_ups >= 1
+        assert len(router.replicas) == 2
+        assert router.counters.replicas_added == 1
+        # the grown fleet serves through both replicas
+        for i in range(4, 8):
+            rid = f"a{i}"
+            rids.append(rid)
+            router.submit(Request(rid=rid, prompt=prompts[i]))
+        results += router.run_until_idle()
+        _assert_exactly_once(results, rids)
+        assert all(isinstance(r, Completed) for r in results)
+        # load drops; relax the latency SLO (cumulative percentiles
+        # never decay) so the cold-fleet down-trigger can fire
+        auto.policy.ttft_p95_ms = 1e9
+        while auto.counters.scale_downs == 0 and auto.counters.polls < 20:
+            auto.step()
+        assert auto.counters.scale_downs == 1
+        assert len(router.replicas) == 1
+        for _ in range(50):                 # sweep closes the idle one
+            router.pump()
+            if not router._retiring:
+                break
+        assert not router._retiring
+        assert router.counters.replicas_retired == 1
+        snap = export.collect()
+        assert snap["autoscaler/scale_ups"] >= 1.0
+        assert snap["autoscaler/scale_downs"] == 1.0
+        assert snap["serve_fleet/replicas"] == 1.0
+        assert "rocket_tpu_autoscaler_scale_ups" in export.prometheus_text()
+    finally:
+        export.unregister_source("autoscaler")
+        export.unregister_source("serve_fleet")
+        router.close()
+        for rep in spawned:
+            rep.close()
+
+
+@pytest.mark.slow
+@pytest.mark.elastic
+def test_respawn_elastic_restores_from_snapshot(tmp_path, prompts):
+    """A worker spawned with ``restore_dir`` serves the SNAPSHOT weights
+    (not its seed default) — and still does after a kill -9 respawn."""
+    from rocket_tpu.models.generate import speculative_generate_batched
+
+    tw.save_tiny_snapshot(str(tmp_path), seed_target=11)
+    model, draft, p11, _ = tw.tiny_models(seed_target=11)
+    _, _, _, dparams = tw.tiny_models()
+
+    def expect(i):
+        toks = speculative_generate_batched(
+            model, p11, draft, dparams, prompts[i][None, :],
+            max_new_tokens=tw.TOTAL - tw.P, n_draft=tw.NDRAFT)
+        return np.asarray(toks[0])
+
+    spec = WorkerSpec(builder=BUILDER, restore_dir=str(tmp_path))
+    rep = ProcReplica(spec, "el-0", spawn_timeout_s=SPAWN_S,
+                      rpc_timeout_s=SPAWN_S)
+    try:
+        assert rep.submit(Request(rid="e0", prompt=prompts[0]))
+        (res,) = _pump_until_done(rep, 1)
+        assert np.array_equal(np.asarray(res.tokens), expect(0))
+        rep.kill()
+        _await_corpse(rep)
+        assert not rep.probe()
+        final, salvaged = rep.heal()
+        assert not final and not salvaged
+        assert rep.spawns == 2
+        assert rep.submit(Request(rid="e1", prompt=prompts[1]))
+        (res,) = _pump_until_done(rep, 1)
+        assert np.array_equal(np.asarray(res.tokens), expect(1))
+    finally:
+        rep.close()
