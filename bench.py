@@ -1132,12 +1132,90 @@ def bench_pipeline(n_steps, warmup):
     }
 
 
+def bench_cold_vs_warm(n_steps, warmup, *, cache_dir=None):
+    """Warm-start record (ISSUE 15): two SEQUENTIAL spawns of an
+    identical WorkerSpec sharing one fresh compile-cache dir.  The cold
+    spawn populates the persistent cache + AOT store; the warm spawn's
+    READY payload should report a goodput ``compile`` bucket well under
+    half the cold one's (the ``TestWarmStartGuard`` threshold), with
+    spawn→READY both ways and bit-equal first tokens."""
+    import tempfile
+
+    import numpy as np
+
+    from rocket_tpu.serve.procfleet import ProcReplica
+    from rocket_tpu.serve.types import Request
+    from rocket_tpu.serve.wire import WorkerSpec
+
+    if cache_dir is None:
+        cache_dir = tempfile.mkdtemp(prefix="rocket-cc-bench-")
+    spec = WorkerSpec(builder="rocket_tpu.testing.workers:build_tiny_loop",
+                      kwargs={"warmup": "auto"})
+    env = {"ROCKET_TPU_COMPILE_CACHE": cache_dir, "JAX_PLATFORMS": "cpu"}
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(1, 64, size=(8,)).astype(np.int32)
+    phases = {}
+    for phase in ("cold", "warm"):
+        t0 = time.perf_counter()
+        rep = ProcReplica(spec, f"bench-{phase}", spawn_timeout_s=600.0,
+                          rpc_timeout_s=600.0, env=env)
+        spawn_ready_s = time.perf_counter() - t0
+        try:
+            tokens = None
+            if rep.submit(Request(rid="r0", prompt=prompt)):
+                for _ in range(400):
+                    rep.pump()
+                    out = rep.drain_results()
+                    if out:
+                        tokens = np.asarray(out[0].tokens).tolist()
+                        break
+            phases[phase] = {
+                "spawn_ready_s": round(spawn_ready_s, 4),
+                "compile_s": round(
+                    float(rep.ready_info.get("compile_ms", 0.0)) / 1e3, 4),
+                "cache_hits": int(rep.ready_info.get("cache_hits", 0)),
+                "warm_stats": rep.ready_info.get("warm_stats", {}),
+                "first_token_ms": rep.first_token_ms.percentile(50),
+                "tokens": tokens,
+            }
+        finally:
+            rep.close()
+    cold, warm = phases["cold"], phases["warm"]
+    ratio = (warm["compile_s"] / cold["compile_s"]
+             if cold["compile_s"] > 0 else None)
+    bit_equal = (cold["tokens"] is not None
+                 and cold["tokens"] == warm["tokens"])
+    guard = ("warm<0.5x cold, bit-equal: ok"
+             if ratio is not None and ratio < 0.5 and bit_equal else
+             f"warm compile {warm['compile_s']}s vs cold "
+             f"{cold['compile_s']}s (ratio {ratio}), "
+             f"bit_equal={bit_equal}")
+    for phase in phases.values():
+        phase.pop("tokens", None)   # the record needs the verdict, not 24 ints
+    return {
+        "config": "cold_vs_warm",
+        "metric": ("worker spawn compile cost, cold vs warm persistent "
+                   "compile cache + AOT store (CPU proxy, tiny pair)"),
+        "value": round(1.0 / ratio, 2) if ratio else None,
+        "unit": "compile_speedup_x",
+        "vs_baseline": None,
+        "cold": cold,
+        "warm": warm,
+        "bit_equal": bit_equal,
+        "guard": guard,
+        "device": jax.devices()[0].device_kind,
+        "baseline_note": "cold = fresh cache dir; warm = identical spec, "
+                         "same dir, new process",
+    }
+
+
 BENCHES = {
     "resnet50": bench_resnet50,
     "vit": bench_vit_b16,
     "gpt2": bench_gpt2,
     "decode": bench_gpt2_decode,
     "pipeline": bench_pipeline,
+    "cold_vs_warm": bench_cold_vs_warm,
 }
 
 
@@ -1223,7 +1301,7 @@ def main() -> None:
                           f"{labels.get(name, 'train throughput')} "
                           f"(1 chip, {wdt})",
                 "value": None,
-                "unit": units[name],
+                "unit": units.get(name, "x"),
                 "vs_baseline": None,
                 "error": f"{type(exc).__name__}: {exc}",
             }

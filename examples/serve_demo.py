@@ -540,14 +540,20 @@ def run_fleet_proc(args, model, draft, params, draft_params,
     snap_root = tempfile.mkdtemp(prefix="rocket_tpu_fleet_proc_")
     snap_path = tw.save_tiny_snapshot(snap_root)
     print(f"  [proc] workers elastic-restore from {snap_path}")
+    autoscale = args.autoscale or args.standby > 0
+    spec_kwargs = {"queue_capacity": max(args.queue_capacity, 16),
+                   "kvstore_page_tokens": 4,
+                   "restore_dir": snap_root}
+    if args.standby > 0:
+        # pre-warmed spawns: every worker (standbys included) runs its
+        # WarmupPlan against the persistent compile cache before READY
+        spec_kwargs["warmup"] = "auto"
     spec = WorkerSpec(
         builder="rocket_tpu.testing.workers:build_tiny_loop",
-        kwargs={"queue_capacity": max(args.queue_capacity, 16),
-                "kvstore_page_tokens": 4,
-                "restore_dir": snap_root},
+        kwargs=spec_kwargs,
     )
     index = SharedPrefixIndex(page_tokens=4)
-    n0 = 1 if args.autoscale else min(max(args.replicas, 2), 4)
+    n0 = 1 if autoscale else min(max(args.replicas, 2), 4)
 
     def spawn(rid):
         t = time.perf_counter()
@@ -560,14 +566,18 @@ def run_fleet_proc(args, model, draft, params, draft_params,
     router = FleetRouter(reps, prefix_index=index)
     register_fleet_source(router)
     auto = None
-    if args.autoscale:
+    if autoscale:
         auto = Autoscaler(router, spawn, SLOPolicy(
             ttft_p95_ms=5.0, max_shed_rate=0.02, breach_rounds=1,
             min_replicas=1, max_replicas=4,
             scale_up_cooldown_s=0.0, scale_down_cooldown_s=0.0,
-            drain_below_load=0.5))
+            drain_below_load=0.5, standby=max(0, args.standby)))
         print("  [proc] autoscaler armed: TTFT p95 SLO 5 ms, "
               "1..4 worker processes")
+        if args.standby > 0:
+            ready = auto.wait_standby(timeout_s=120.0)
+            print(f"  [proc] standby pool: {ready} pre-warmed worker(s) "
+                  f"waiting off-rotation (scale-up = rename, not spawn)")
     kill_tick = args.kill_round if args.kill_round >= 0 else max(2, R // 3)
     injector = None
     if args.kill_round != -2:
@@ -628,10 +638,16 @@ def run_fleet_proc(args, model, draft, params, draft_params,
             if auto.counters.scale_downs > 0 and not router._retiring:
                 break
         for ev in auto.events:
+            extra = ""
+            if ev.get("standby"):
+                extra = (f" (standby promotion, worker compiled "
+                         f"{ev.get('compile_ms', 0.0):.0f} ms before "
+                         f"joining rotation)")
             print(f"  [proc] autoscale event: {ev['action']} "
-                  f"{ev['replica']}")
+                  f"{ev['replica']}{extra}")
         print(f"  [proc] autoscaler: {auto.counters.scale_ups} scale-up(s),"
               f" {auto.counters.scale_downs} scale-down(s), "
+              f"{auto.counters.standby_promotions} standby promotion(s), "
               f"{len(router.replicas)} worker(s) remain")
 
     kinds = {Completed: "completed", Overloaded: "overloaded",
@@ -660,6 +676,8 @@ def run_fleet_proc(args, model, draft, params, draft_params,
             print(f"  [proc] {name:<8} p50 {p50:8.1f}  "
                   f"p95 {summary[f'{name}/p95']:8.1f} "
                   f"(merged across worker processes)")
+    if auto is not None:
+        auto.close()    # retire the standby pool's off-rotation workers
     router.close()
     unregister_source("serve_fleet")
     if auto is not None:
@@ -818,6 +836,12 @@ def main():
                              "and let the goodput-driven Autoscaler "
                              "grow/drain the fleet off the metrics "
                              "surface (TTFT p95 SLO)")
+    parser.add_argument("--standby", type=int, default=0,
+                        help="[fleet-proc] keep N pre-warmed standby "
+                             "worker processes off-rotation (implies "
+                             "--autoscale); scale-up promotes one by "
+                             "rename instead of paying a cold spawn + "
+                             "compile on the latency path")
     parser.add_argument("--kv-bytes", type=int, default=1 << 28,
                         help="[cache] prefix-store byte budget (LRU "
                              "eviction past it)")

@@ -448,9 +448,35 @@ class Module(Dispatcher):
         # dispatch routes through ``observe.ledger.ledger_call``, so a
         # post-warmup retrace of any of them trips the runtime sentinel.
         # The span times only host-side jit construction (compilation
-        # happens at first dispatch, where the ledger attributes it).
+        # happens at first dispatch, where the ledger attributes it —
+        # :meth:`warm_start` moves that compile ahead of the first real
+        # batch, against the persistent compile cache).
         with trace_span("module/build_steps", fused=self._use_window):
             self._build_steps_inner(policy)
+
+    def warm_start(self, batch: Any) -> Optional[dict]:
+        """AOT-compile the built train step against a representative
+        ``batch`` (ISSUE 15): ``lower().compile()`` — served from /
+        written to the persistent compile cache, with executable
+        serialization where the backend supports it — so the first real
+        step dispatches a pre-built executable instead of compiling
+        inline.  Returns the warmup stats dict, or ``None`` when steps
+        are not built yet.  Never raises; a failed warm just means the
+        first dispatch compiles as before."""
+        try:
+            from rocket_tpu.tune.warmup import warm_module_step
+
+            stats = warm_module_step(self, batch)
+            if stats is not None:
+                self._logger.info(
+                    "warm_start: %d edge(s) in %.0fms (%d cache hits)",
+                    stats["edges"], stats["compile_ms"],
+                    stats["cache_hits"])
+            return stats
+        except Exception:
+            self._logger.warning("warm_start failed; first dispatch will "
+                                 "compile inline", exc_info=True)
+            return None
 
     def _build_steps_inner(self, policy) -> None:
         skip = (

@@ -153,6 +153,20 @@ class Launcher(Dispatcher):
         runtime.stop_reason = None
         self.bind(runtime)
         self._create_project_dir(runtime)
+        # Warm-start tier (ISSUE 15): arm the per-host persistent
+        # compile cache before anything traces — a relaunch then pays
+        # disk retrieval instead of XLA compilation for every executable
+        # a previous run built.  Unconditional (disable via
+        # $ROCKET_TPU_COMPILE_CACHE=off) and never fatal.
+        try:
+            from rocket_tpu.tune import compile_cache
+
+            armed = compile_cache.enable_compile_cache()
+            if armed is not None:
+                self._logger.info("persistent compile cache: %s", armed)
+        except Exception:
+            self._logger.warning(
+                "persistent compile cache unavailable", exc_info=True)
         if getattr(runtime, "tracing", False):
             self._arm_flight_recorder(runtime)
         if self._goodput:

@@ -86,7 +86,28 @@ class CompileRecord:
     signature: str
     wall_ms: float
     retrace: bool  # True = the edge was already warm (post-warmup)
+    cache_hit: bool = False  # served from the persistent compile cache
     ts: float = field(default_factory=time.time)
+
+
+_cc_state: Any = None
+
+
+def _cc_hit_count() -> int:
+    """Persistent-compile-cache hit counter, 0 when the tier is absent.
+    Sampled on the dispatch hot path, so it must never raise and must be
+    cheap: a lock-free dict read (the GIL makes the int read atomic; a
+    one-tick-stale value only shifts which record a concurrent hit
+    stamps, never loses it)."""
+    global _cc_state
+    if _cc_state is None:
+        try:
+            from rocket_tpu.tune import compile_cache
+
+            _cc_state = compile_cache._state
+        except Exception:
+            return 0
+    return int(_cc_state.get("hits", 0))
 
 
 class RetraceLedger:
@@ -115,6 +136,7 @@ class RetraceLedger:
         self.compiles = 0
         self.retraces = 0
         self.sentinel_dumps = 0
+        self.cache_hits = 0
 
     # -- configuration --------------------------------------------------
 
@@ -142,6 +164,7 @@ class RetraceLedger:
             self.compiles = 0
             self.retraces = 0
             self.sentinel_dumps = 0
+            self.cache_hits = 0
 
     # -- the dispatch wrapper (hot path when armed) ---------------------
 
@@ -153,6 +176,7 @@ class RetraceLedger:
             before = cache_size()
         except Exception:
             return fn(*args, **kwargs)
+        hits_before = _cc_hit_count()
         t0 = time.perf_counter()
         out = fn(*args, **kwargs)
         try:
@@ -165,22 +189,26 @@ class RetraceLedger:
             return out
         # Cold path from here down: a trace/compile happened.
         wall_s = time.perf_counter() - t0
-        self._on_compile(name, args, kwargs, wall_s)
+        self._on_compile(name, args, kwargs, wall_s,
+                         cache_hit=_cc_hit_count() > hits_before)
         return out
 
     def _on_compile(self, name: str, args: tuple, kwargs: dict,
-                    wall_s: float) -> None:
+                    wall_s: float, cache_hit: bool = False) -> None:
         sig = _arg_signature(args, kwargs)
         retrace = name in self._warm
-        rec = CompileRecord(name, sig, wall_s * 1e3, retrace)
+        rec = CompileRecord(name, sig, wall_s * 1e3, retrace, cache_hit)
         tracer = get_tracer()
         with self._lock:
             self._records.append(rec)
             self.compiles += 1
             if retrace:
                 self.retraces += 1
+            if cache_hit:
+                self.cache_hits += 1
         tracer.instant("ledger/compile", executable=name, shapes=sig,
-                       wall_ms=rec.wall_ms, retrace=retrace)
+                       wall_ms=rec.wall_ms, retrace=retrace,
+                       cache_hit=cache_hit)
         tracer.counter("ledger/compiles", self.compiles, executable=name)
         get_goodput().add("compile", wall_s, nested=True)
         if not retrace:
@@ -228,6 +256,7 @@ class RetraceLedger:
             "retraces": float(self.retraces),
             "sentinel_dumps": float(self.sentinel_dumps),
             "warm_edges": float(len(self._warm)),
+            "cache_hits": float(self.cache_hits),
         }
 
 

@@ -34,11 +34,13 @@ measurement budget (the same rung discipline as
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from rocket_tpu.observe import export
+from rocket_tpu.observe.trace import Histogram
 
 __all__ = [
     "SLOPolicy",
@@ -57,7 +59,13 @@ class SLOPolicy:
     shapes the control loop: floors/ceilings on fleet size, consecutive
     breach polls required before acting, per-direction cooldowns, and
     the cold-fleet threshold (mean in-flight load per replica) below
-    which capacity drains."""
+    which capacity drains.
+
+    ``standby`` (ISSUE 15) keeps N already-spawned, already-warm
+    replicas OUTSIDE the router: a scale-up promotes one in O(route)
+    time — rename, add, serve — instead of paying spawn+build+compile
+    inside the breach, and ``heal()`` prefers one over a cold respawn.
+    The pool refills in the background after each promotion."""
 
     ttft_p95_ms: float = 500.0
     max_shed_rate: float = 0.05
@@ -67,6 +75,7 @@ class SLOPolicy:
     scale_up_cooldown_s: float = 3.0
     scale_down_cooldown_s: float = 10.0
     drain_below_load: float = 0.25
+    standby: int = 0
 
 
 class AutoscaleCounters:
@@ -85,6 +94,8 @@ class AutoscaleCounters:
         self.spawn_failures = 0
         self.last_decision = 0      # +1 scaled up, -1 drained, 0 held
         self.target_replicas = 0
+        self.standby_promotions = 0
+        self.standby_ready = 0      # gauge: warm standbys in the pool
 
     def snapshot(self) -> Dict[str, float]:
         return {
@@ -99,6 +110,8 @@ class AutoscaleCounters:
             "spawn_failures": float(self.spawn_failures),
             "last_decision": float(self.last_decision),
             "target_replicas": float(self.target_replicas),
+            "standby_promotions": float(self.standby_promotions),
+            "standby_ready": float(self.standby_ready),
         }
 
 
@@ -116,6 +129,18 @@ def register_fleet_source(router: Any,
         out["replicas_retiring"] = float(len(router._retiring))
         out["load"] = float(sum(max(0, int(rep.load)) for rep in reps
                                 if rep.load < (1 << 29)))
+        # Warm-start telemetry (ISSUE 15): spawn→READY, heal→READY and
+        # spawn→first-token percentiles merged across the fleet — a
+        # heal's cost is now visible on /metrics, not just in logs.
+        # Thread-backed replicas have no spawn, so empty merges export
+        # no keys.
+        for attr in ("spawn_ms", "heal_ms", "first_token_ms"):
+            merged = Histogram()
+            for rep in reps:
+                hist = getattr(rep, attr, None)
+                if isinstance(hist, Histogram):
+                    merged.merge(hist)
+            out.update(merged.summary(attr))
         return out
 
     export.register_source(name, _snapshot)
@@ -160,7 +185,93 @@ class Autoscaler:
         self._prev_shed: Optional[float] = None
         self._prev_submitted: Optional[float] = None
         self.events: List[Dict[str, Any]] = []
+        # Standby pool (ISSUE 15): warm replicas spawned OUTSIDE the
+        # router.  The initial fill is synchronous — a pool that is
+        # still compiling when the breach lands defeats its purpose —
+        # refills after a promotion run on background threads.
+        self._standby_lock = threading.Lock()
+        self._standbys: List[Any] = []
+        self._standby_seq = 0
+        self._refill_threads: List[threading.Thread] = []
+        self._closed = False
+        for _ in range(max(0, int(self.policy.standby))):
+            self._spawn_standby()
+        if self.policy.standby > 0:
+            for rep in list(self.router.replicas):
+                self._wire_heal_preference(rep)
         export.register_source("autoscaler", self.counters.snapshot)
+
+    # -- standby pool ---------------------------------------------------
+
+    def _wire_heal_preference(self, rep: Any) -> None:
+        """Point a replica's heal path at the pool (ProcReplica exposes
+        ``standby_source``; thread-backed fakes don't and are skipped)."""
+        if hasattr(rep, "standby_source"):
+            rep.standby_source = self._take_standby
+
+    def _spawn_standby(self) -> None:
+        with self._standby_lock:
+            self._standby_seq += 1
+            rid = f"standby-{self._standby_seq}"
+        try:
+            rep = self._spawn_fn(rid)
+        except Exception as exc:
+            self.counters.spawn_failures += 1
+            self._log.warning("autoscale: standby spawn %s failed: %r",
+                              rid, exc)
+            return
+        with self._standby_lock:
+            if self._closed:
+                try:
+                    rep.close()
+                except Exception:
+                    pass
+                return
+            self._standbys.append(rep)
+            self.counters.standby_ready = len(self._standbys)
+        self._log.info("autoscale: standby %s warm (compile %.0fms)",
+                       rid, float(getattr(rep, "compile_ms", 0.0)))
+
+    def _take_standby(self) -> Optional[Any]:
+        """Pop a warm standby (None when the pool is empty) and kick a
+        background refill so the pool converges back to ``standby``."""
+        with self._standby_lock:
+            rep = self._standbys.pop(0) if self._standbys else None
+            self.counters.standby_ready = len(self._standbys)
+            closed = self._closed
+        if rep is not None and not closed:
+            thread = threading.Thread(
+                target=self._spawn_standby, name="autoscale-standby-refill",
+                daemon=True)
+            thread.start()
+            self._refill_threads.append(thread)
+        return rep
+
+    def wait_standby(self, timeout_s: float = 300.0) -> int:
+        """Block until background refills settle; returns the pool size
+        (test/teardown helper — the control loop never waits)."""
+        deadline = time.monotonic() + timeout_s
+        for thread in list(self._refill_threads):
+            thread.join(timeout=max(0.0, deadline - time.monotonic()))
+        self._refill_threads = [
+            t for t in self._refill_threads if t.is_alive()]
+        with self._standby_lock:
+            return len(self._standbys)
+
+    def close(self) -> None:
+        """Tear the pool down: unplaced standbys are real worker
+        processes and must not outlive the autoscaler."""
+        with self._standby_lock:
+            self._closed = True
+            standbys, self._standbys = self._standbys, []
+            self.counters.standby_ready = 0
+        for thread in list(self._refill_threads):
+            thread.join(timeout=10.0)
+        for rep in standbys:
+            try:
+                rep.close()
+            except Exception:
+                pass
 
     # -- signal extraction ---------------------------------------------
 
@@ -226,20 +337,54 @@ class Autoscaler:
             return 0
         self._spawned += 1
         rid = f"scale-{self._spawned}"
+        # A warm standby is promoted in O(route) time: rename over the
+        # wire, add to the router — no spawn, no build, no compile
+        # inside the breach.  Any promotion failure falls back to the
+        # cold spawn path.
+        rep = None
+        promoted = False
+        standby = self._take_standby()
+        if standby is not None:
+            try:
+                if hasattr(standby, "rename"):
+                    standby.rename(rid)
+                else:
+                    standby.replica_id = rid
+                rep = standby
+                promoted = True
+            except Exception as exc:
+                self._log.warning(
+                    "autoscale: standby promotion to %s failed: %r",
+                    rid, exc)
+                try:
+                    standby.close()
+                except Exception:
+                    pass
         try:
-            rep = self._spawn_fn(rid)
+            if rep is None:
+                rep = self._spawn_fn(rid)
             self.router.add_replica(rep)
         except Exception as exc:
             self.counters.spawn_failures += 1
             self._log.warning("autoscale: spawn %s failed: %r", rid, exc)
             return 0
+        if self.policy.standby > 0:
+            self._wire_heal_preference(rep)
+        compile_ms = float(getattr(rep, "compile_ms", 0.0))
         self._last_up_at = now
         self._up_streak = 0
         self.counters.scale_ups += 1
+        if promoted:
+            self.counters.standby_promotions += 1
         self.counters.target_replicas = len(self.router.replicas)
-        self.events.append({"t": now, "action": "scale_up", "replica": rid})
-        self._log.info("autoscale: scaled up -> %s (%d replicas)",
-                       rid, len(self.router.replicas))
+        self.events.append({"t": now, "action": "scale_up", "replica": rid,
+                            "standby": promoted, "compile_ms": compile_ms})
+        self._log.info(
+            "autoscale: scaled up -> %s (%d replicas, %s, "
+            "worker compile %.0fms)",
+            rid, len(self.router.replicas),
+            "promoted warm standby" if promoted else "cold spawn",
+            compile_ms)
         return 1
 
     def _try_scale_down(self, now: float) -> int:

@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from rocket_tpu.observe.trace import Histogram
 from rocket_tpu.serve import wire
 from rocket_tpu.serve.metrics import ServeLatency
 from rocket_tpu.serve.types import HealthState, ReplicaId, Request
@@ -95,6 +96,20 @@ class ProcReplica:
         self.latency = ServeLatency()
         self.counters: Dict[str, float] = {}
         self.spawns = 0
+        # Warm-start telemetry (ISSUE 15): the READY payload the worker
+        # sent (compile_ms / cache_hits / warm_stats), plus spawn→READY,
+        # heal→READY, and spawn→first-token latency histograms exported
+        # via ``register_fleet_source``.
+        self.ready_info: Dict[str, Any] = {}
+        self.compile_ms: float = 0.0
+        self.spawn_ms = Histogram()
+        self.heal_ms = Histogram()
+        self.first_token_ms = Histogram()
+        # heal() asks this for an already-warm standby replica before
+        # paying a cold respawn; wired by the Autoscaler's standby pool.
+        self.standby_source: Optional[Callable[[], Optional[Any]]] = None
+        self._spawn_t0: float = 0.0
+        self._first_token_pending = False
         self.proc: Optional[subprocess.Popen] = None
         self._fs = None
         self._spawn()
@@ -102,6 +117,7 @@ class ProcReplica:
     # -- process lifecycle ---------------------------------------------
 
     def _spawn(self) -> None:
+        t0 = self._clock()
         listener = FrameListener(0)
         try:
             cmd = [
@@ -129,9 +145,16 @@ class ProcReplica:
         self._load = 0
         self._health = HealthState.SERVING
         self.latency = ServeLatency()
-        self._log.info("fleet: replica %s worker pid=%s up (%s devices)",
-                       self.replica_id, payload.get("pid"),
-                       payload.get("devices"))
+        self.ready_info = dict(payload or {})
+        self.compile_ms = float(self.ready_info.get("compile_ms", 0.0))
+        self.spawn_ms.record((self._clock() - t0) * 1e3)
+        self._spawn_t0 = t0
+        self._first_token_pending = True
+        self._log.info(
+            "fleet: replica %s worker pid=%s up (%s devices, "
+            "compile %.0fms, %s cache hits)",
+            self.replica_id, payload.get("pid"), payload.get("devices"),
+            self.compile_ms, self.ready_info.get("cache_hits", 0))
 
     @property
     def pid(self) -> Optional[int]:
@@ -244,7 +267,14 @@ class ProcReplica:
         if reply is None:
             return False
         with self._lock:
-            self._results.extend(reply.get("results", ()))
+            results = reply.get("results", ())
+            if results and self._first_token_pending:
+                # spawn→first-token: the latency a request routed to a
+                # fresh (or healed) replica actually experienced.
+                self.first_token_ms.record(
+                    (self._clock() - self._spawn_t0) * 1e3)
+                self._first_token_pending = False
+            self._results.extend(results)
             self._load = int(reply.get("load", 0))
             try:
                 self._health = HealthState(reply["health"])
@@ -272,6 +302,13 @@ class ProcReplica:
         """Stop the worker admitting new requests (autoscaler retire)."""
         self._rpc(wire.DRAIN)
 
+    def collect(self) -> Optional[Dict[str, Any]]:
+        """One COLLECT RPC: counters + latency plus the worker's retrace
+        ledger and goodput snapshots — the cross-process read of the same
+        ledgers an in-process loop exposes (the warm-start acceptance
+        checks ``ledger["retraces"]`` and ``goodput["compile_s"]``)."""
+        return self._rpc(wire.COLLECT)
+
     # -- self-healing ---------------------------------------------------
 
     def heal(self) -> Tuple[List[Any], List[Request]]:
@@ -298,22 +335,89 @@ class ProcReplica:
             # the respawned worker starts with an EMPTY store — every
             # claim the dead one registered is stale at once
             self._prefix_index.invalidate(self.replica_id)
+        # A warm standby beats a cold respawn: adopt its live worker
+        # process (O(route) — no build, no compile) and let the pool
+        # refill in the background.  Any failure falls back to the cold
+        # path below.
+        t_heal = self._clock()
+        promoted = False
+        if self.standby_source is not None:
+            donor = None
+            try:
+                donor = self.standby_source()
+            except Exception:
+                donor = None
+            if donor is not None:
+                try:
+                    self._adopt(donor)
+                    promoted = True
+                except Exception as exc:
+                    self._log.warning(
+                        "fleet: replica %s standby adoption failed: %r",
+                        self.replica_id, exc)
+                    self._reap()
         # respawn BEFORE clearing the death flag (same ordering rule as
         # Replica.heal: submit gates on _dead then uses the transport).
         # A failed respawn leaves the replica dead — salvage already
         # happened, and the next supervision beat retries the spawn.
-        try:
-            self._spawn()
-        except Exception as exc:
-            self._reap()
-            self._dead = f"respawn failed: {exc!r}"
-            self._log.warning("fleet: replica %s respawn failed: %r",
-                              self.replica_id, exc)
-            return final, salvaged
+        if not promoted:
+            try:
+                self._spawn()
+            except Exception as exc:
+                self._reap()
+                self._dead = f"respawn failed: {exc!r}"
+                self._log.warning("fleet: replica %s respawn failed: %r",
+                                  self.replica_id, exc)
+                return final, salvaged
         self._dead = None
+        self.heal_ms.record((self._clock() - t_heal) * 1e3)
         if was_threaded:
             self.start()
         return final, salvaged
+
+    def _adopt(self, donor: "ProcReplica") -> None:
+        """Take over a warm standby's live worker: transfer its process
+        and socket, re-stamp the worker's fleet identity over the wire
+        (RENAME — results must carry THIS replica's id), and reset the
+        per-spawn caches.  The donor is left a marked corpse; its
+        supervisor-side state (no outstanding work — standbys never
+        served) needs no salvage."""
+        with donor._lock:
+            if donor._dead is not None or donor._fs is None:
+                raise RuntimeError("standby is not alive")
+            proc, fs = donor.proc, donor._fs
+            donor.proc, donor._fs = None, None
+            donor._dead = "promoted"
+        self.proc, self._fs = proc, fs
+        # direct wire I/O: self._dead is still set mid-heal, so _rpc
+        # would refuse; the one-in-flight discipline holds via our lock.
+        with self._lock:
+            wire.send_msg(self._fs, wire.RENAME, str(self.replica_id))
+            rkind, reply = wire.recv_msg(self._fs, self._rpc_timeout)
+        if rkind != wire.REPLY:
+            raise RuntimeError(f"RENAME answered {rkind!r}: {reply!r}")
+        self.spawns += 1
+        self._load = 0
+        self._health = HealthState.SERVING
+        self.latency = ServeLatency()
+        self.ready_info = dict(donor.ready_info)
+        self.compile_ms = float(self.ready_info.get("compile_ms", 0.0))
+        self._spawn_t0 = self._clock()
+        self._first_token_pending = True
+        self._log.info("fleet: replica %s adopted warm standby %s (pid=%s)",
+                       self.replica_id, donor.replica_id,
+                       self.ready_info.get("pid"))
+
+    def rename(self, new_rid: ReplicaId) -> None:
+        """Re-stamp a LIVE replica's fleet identity — the autoscaler
+        promotes a warm standby into the router under the scale-up id.
+        The worker re-stamps its loop/queue so every subsequent result's
+        ``meta`` carries the new id."""
+        reply = self._rpc(wire.RENAME, str(new_rid))
+        if reply is None:
+            raise RuntimeError(
+                f"replica {self.replica_id}: RENAME to {new_rid!r} failed")
+        self.replica_id = new_rid
 
     # -- threading ------------------------------------------------------
 

@@ -44,6 +44,9 @@ DRAIN = "drain"          # stop admitting; in-flight work finishes
 COLLECT = "collect"      # counters + latency snapshot (no round)
 SHUTDOWN = "shutdown"    # orderly exit -> BYE, then the process exits
 BYE = "bye"
+RENAME = "rename"        # re-stamp the worker's fleet identity (a warm
+                         # standby promoted into the router must emit
+                         # results under the adopting replica's id)
 REPLY = "reply"          # generic success reply
 ERROR = "error"          # worker -> supervisor: payload is the repr
 

@@ -38,9 +38,38 @@ _HELLO_TIMEOUT_S = 120.0
 _IDLE_TIMEOUT_S = 600.0
 
 
+def _locate_params(manifest: Any) -> tuple:
+    """Find the params subtree inside a snapshot's manifest: the item
+    key and the path prefix under it.  A serving snapshot stores a bare
+    ``{"params": ...}`` item (prefix ``()``); a TRAINER snapshot — the
+    emergency tier flushes whatever the run's capsules hold — stores the
+    whole TrainState under the module's checkpoint key with leaf paths
+    like ``state/params/...``.  Falls back to the bare layout when the
+    manifest is absent or unrecognized."""
+    items = (manifest or {}).get("items") or {}
+    if not items or "params" in items:
+        return "params", ()
+    for key, meta in items.items():
+        for rec in meta.get("structure", []) or []:
+            parts = str(rec.get("path", "")).split("/")
+            if "params" in parts:
+                idx = parts.index("params")
+                return key, tuple(parts[: idx + 1])
+    return "params", ()
+
+
 def restore_params(restore_dir: str, targets: Any) -> Any:
     """Elastic-restore a ``params`` tree from the newest valid snapshot
     under ``restore_dir`` onto whatever devices THIS process got.
+
+    Tier election matches ``resume("auto")``: :func:`~rocket_tpu.persist.
+    integrity.latest_valid` scans the ``DEFAULT_SUBDIRS`` — weights AND
+    the emergency tier — so a worker spawned right after a preemption
+    restores the newest state, even when the only committed snapshot is
+    the SIGTERM-window emergency flush.  That flush may hold a trainer
+    capsule layout (params nested inside a TrainState); the manifest's
+    recorded leaf paths locate the subtree, and the restore goes through
+    ``restore_item(partial=True)`` to pull just the params.
 
     The PR 13 gate runs first: :func:`~rocket_tpu.persist.integrity.
     check_reshard` validates every target leaf (shape, mesh-axis names,
@@ -58,13 +87,21 @@ def restore_params(restore_dir: str, targets: Any) -> Any:
         raise FileNotFoundError(
             f"no valid snapshot under {restore_dir!r} to restore from")
     manifest = integrity.read_manifest(path)
+    item_key, prefix = _locate_params(manifest)
+    nested: Any = targets
+    for part in reversed(prefix):
+        nested = {part: nested}
     if manifest is not None:
-        integrity.check_reshard(manifest, {"params": targets})
+        integrity.check_reshard(manifest, {item_key: nested})
     io = CheckpointIO(use_async=False)
     try:
-        return io.restore(path, targets={"params": targets})["params"]
+        out = io.restore_item(path, item_key, target=nested,
+                              partial=bool(prefix))
     finally:
         io.close()
+    for part in prefix:
+        out = out[part]
+    return out
 
 
 def serve(fs: FramedSocket, loop: Any, *,
@@ -113,10 +150,23 @@ def serve(fs: FramedSocket, loop: Any, *,
             elif kind == wire.DRAIN:
                 loop.drain()
                 wire.send_msg(fs, wire.REPLY, {"health": loop.health.value})
+            elif kind == wire.RENAME:
+                # a promoted standby adopts the scale-up replica's id:
+                # every result from here on is stamped with the new
+                # identity, so the router's shadow stays coherent.
+                loop.replica_id = payload
+                loop.queue.name = payload
+                wire.send_msg(fs, wire.REPLY, {"replica_id": payload})
             elif kind == wire.COLLECT:
+                from rocket_tpu.observe.ledger import (get_goodput,
+                                                       get_retrace_ledger)
+                from rocket_tpu.tune import compile_cache as _cc
                 wire.send_msg(fs, wire.REPLY, {
                     "counters": loop.counters.snapshot(),
                     "latency": loop.latency,
+                    "ledger": get_retrace_ledger().snapshot(),
+                    "goodput": get_goodput().snapshot(),
+                    "compile_cache": _cc.snapshot(),
                 })
             elif kind == wire.SHUTDOWN:
                 wire.send_msg(fs, wire.BYE, {"results": loop.drain_results()})
@@ -153,6 +203,21 @@ def main(argv: Optional[list] = None) -> int:
             wire.send_msg(fs, wire.ERROR,
                           f"expected HELLO WorkerSpec, got {kind!r}")
             return 2
+        # Warm-start tier (ISSUE 15): arm the persistent compile cache
+        # and the ledgers BEFORE the build, so every compile the build
+        # and the WarmupPlan pay is (a) served from / written to the
+        # per-host disk cache and (b) timed into the goodput ``compile``
+        # bucket this worker reports in READY.
+        from rocket_tpu.observe.ledger import arm_ledgers, get_goodput
+        from rocket_tpu.tune import compile_cache
+
+        cache_armed = None
+        try:
+            cache_armed = compile_cache.enable_compile_cache()
+        except Exception:
+            pass  # cold compiles still work; the tier is an accelerant
+        arm_ledgers()
+        t_build = time.perf_counter()
         try:
             loop = spec.build()
             if args.replica_id is not None:
@@ -161,12 +226,19 @@ def main(argv: Optional[list] = None) -> int:
         except Exception:
             wire.send_msg(fs, wire.ERROR, traceback.format_exc())
             return 2
+        build_ms = (time.perf_counter() - t_build) * 1e3
         import jax
 
         wire.send_msg(fs, wire.READY, {
             "pid": os.getpid(),
             "devices": int(jax.local_device_count()),
             "platform": jax.default_backend(),
+            "build_ms": build_ms,
+            "compile_ms": get_goodput().snapshot().get("compile_s", 0.0)
+            * 1e3,
+            "cache_hits": compile_cache.hit_count(),
+            "cache_dir": cache_armed,
+            "warm_stats": dict(getattr(loop, "warm_stats", None) or {}),
         })
         return serve(fs, loop)
     finally:

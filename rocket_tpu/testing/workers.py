@@ -68,6 +68,7 @@ def build_tiny_loop(
     restore_dir: Optional[str] = None,
     kvstore_page_tokens: Optional[int] = None,
     watchdog_timeout: Optional[float] = None,
+    warmup: Optional[Any] = None,
 ) -> Any:
     """The WorkerSpec builder: a fresh ServingLoop over the tiny pair.
 
@@ -75,7 +76,9 @@ def build_tiny_loop(
     elastic restore from the newest valid snapshot under it (the seeded
     tree doubles as the ``check_reshard`` target template).
     ``kvstore_page_tokens`` arms a per-process prefix cache whose new
-    page hashes ship to the supervisor's shared index on every STEP."""
+    page hashes ship to the supervisor's shared index on every STEP.
+    ``warmup`` (``"auto"`` / a WarmupPlan wire dict) arms the AOT
+    warm-start tier — plain data, so it rides WorkerSpec kwargs."""
     from rocket_tpu.models.generate import ContinuousBatcher
     from rocket_tpu.serve.kvstore import PrefixKVStore
     from rocket_tpu.serve.loop import ServingLoop
@@ -101,6 +104,7 @@ def build_tiny_loop(
         queue_capacity=int(queue_capacity),
         watchdog_timeout=watchdog_timeout,
         kvstore=kvstore,
+        warmup=warmup,
     )
 
 
@@ -127,3 +131,29 @@ def save_tiny_snapshot(root: str, *, seed_target: int = SEED_TARGET) -> str:
     finally:
         io.close()
     return path
+
+
+def save_tiny_emergency(root: str, *, seed_target: int = SEED_TARGET,
+                        iter_idx: int = 3,
+                        trainer_layout: bool = False) -> str:
+    """Write an EMERGENCY-tier-only snapshot under ``<root>/emergency/``
+    (no ``weights/`` sibling) — the post-preemption shape a freshly
+    spawned worker must elect from.  ``trainer_layout=True`` nests the
+    params the way a trainer capsule flushes them
+    (``{"model": {"state": {...}}}``), exercising the manifest-guided
+    subtree location in :func:`~rocket_tpu.serve.worker.restore_params`."""
+    import jax
+
+    from rocket_tpu.persist.emergency import EmergencyTier
+
+    _, _, params, _ = tiny_models(seed_target=seed_target)
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()).reshape(-1), ("data",))
+    if trainer_layout:
+        items = {"model": {"state": {"params": params,
+                                     "step": np.int32(iter_idx)}}}
+    else:
+        items = {"params": params}
+    tier = EmergencyTier(os.path.abspath(root))
+    tier.capture(items, iter_idx=iter_idx, mesh=mesh)
+    return tier.flush("test-preemption")

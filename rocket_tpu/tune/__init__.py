@@ -15,10 +15,23 @@ The bench ladder measures; this package closes the loop:
 - :mod:`rocket_tpu.tune.store` — per-(model, device, batch, backend)
   JSON records under ``experiments/tunes/`` with a :func:`best_tune`
   lookup that ``bench.py``, ``Module``, and the engine step consult as
-  defaults — a completed search changes real runs with zero re-search.
+  defaults — a completed search changes real runs with zero re-search;
+- :mod:`rocket_tpu.tune.compile_cache` — the warm-start tier's disk
+  layer: arms JAX's persistent compilation cache at a per-host dir and
+  serializes AOT executables where the backend supports it;
+- :mod:`rocket_tpu.tune.warmup` — :class:`WarmupPlan`: explicit
+  ``lower().compile()`` of the serving hot path's fixed-shape edges
+  before the first request (and a built ``Module``'s train step),
+  against that cache.
 
 CLI: ``python -m rocket_tpu.tune --help``.
 """
+
+from rocket_tpu.tune.compile_cache import (  # noqa: F401
+    cache_dir,
+    enable_compile_cache,
+    hit_count,
+)
 
 from rocket_tpu.tune.cost_model import (  # noqa: F401
     device_peak_flops,
@@ -39,4 +52,10 @@ from rocket_tpu.tune.store import (  # noqa: F401
     runtime_default,
     save_tune,
     tune_dir,
+)
+from rocket_tpu.tune.warmup import (  # noqa: F401
+    WarmupPlan,
+    plan_for_batcher,
+    warm_batcher,
+    warm_module_step,
 )

@@ -6,12 +6,25 @@ fake backend.  Must run before the first ``import jax`` in any test module.
 """
 
 import os
+import tempfile
 
 # Neutralize the axon TPU tunnel for tests: sitecustomize imports jax at
 # interpreter start, so plain env vars are too late — but backend selection
 # is lazy until the first jax.devices(), so switching the platform via
 # jax.config still works here.
 os.environ["JAX_PLATFORMS"] = "cpu"
+
+# Hermetic warm-start tier: any test arming the persistent compile cache
+# without an explicit dir must land in a fresh per-session tmp dir, never
+# the repo's shared experiments/compile_cache/ — a populated shared cache
+# changes what LATER sessions' compiles return (a cache-retrieved
+# executable reports alias_size_in_bytes=0 in memory_analysis(), breaking
+# the donation guards in test_ladder_shapes.py) and would make tier-1
+# results depend on who ran before.  Tests that probe dir resolution
+# override this env var themselves.
+os.environ.setdefault(
+    "ROCKET_TPU_COMPILE_CACHE",
+    tempfile.mkdtemp(prefix="rocket_tpu_test_compile_cache_"))
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -76,6 +89,14 @@ def pytest_configure(config):
         "docs/reliability.md \"Process fleet & autoscaling\"; the "
         "full kill-mid-burst and autoscale bursts are slow)",
     )
+    config.addinivalue_line(
+        "markers",
+        "warmstart: warm-start tier tests (rocket_tpu.tune "
+        "compile_cache/warmup — persistent compile cache, AOT "
+        "executable reuse, pre-warmed/standby spawns; see "
+        "docs/performance.md \"Warm start & compile cache\"; "
+        "spawn-heavy cases ride the heavy tail of collection ordering)",
+    )
 
 
 # Fast-first ordering: the handful of files below carry the long
@@ -98,7 +119,11 @@ _HEAVY_TAIL = (
 
 
 def pytest_collection_modifyitems(config, items):
-    items.sort(key=lambda item: item.fspath.basename in _HEAVY_TAIL)
+    # warmstart-marked items spawn worker subprocesses — heavy-tail them
+    # alongside the listed files so tier-1 truncation behavior holds.
+    items.sort(key=lambda item: (
+        item.fspath.basename in _HEAVY_TAIL
+        or item.get_closest_marker("warmstart") is not None))
 
 
 @pytest.fixture(scope="session")

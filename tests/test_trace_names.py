@@ -93,6 +93,132 @@ def test_library_emits_trace_events():
             "quant/int8_matmul/fallback"} <= names
 
 
+# -- jax.jit chokepoint lint (ISSUE 15 satellite) ----------------------------
+#
+# Every ``jax.jit`` call site in the library must either dispatch through
+# ``observe.ledger.ledger_call`` (the retrace sentinel + warm-start
+# chokepoint) or appear below with the reason it legitimately doesn't.
+# The assertion is STRICT set equality: a new jit edge fails until it is
+# consciously classified here, and a removed one fails until its stale
+# entry is dropped — sites can't silently dodge the sentinel or the
+# WarmupPlan.  Keys are ``(path-under-rocket_tpu, enclosing def/assign)``.
+
+KNOWN_JIT_SITES = {
+    # ledgered: dispatch routes through ledger_call
+    ("engine/step.py", "steps"): "ledgered via _AnnotatedStep (sync)",
+    ("engine/step.py", "build_train_step"):
+        "ledgered via _AnnotatedStep (micro)",
+    ("engine/step.py", "build_window_step"):
+        "ledgered via _AnnotatedStep (window)",
+    ("engine/step.py", "build_eval_step"):
+        "ledgered via _AnnotatedStep (eval)",
+    ("models/generate.py", "_spec_prefill"):
+        "ledgered: ContinuousBatcher.start",
+    ("models/generate.py", "_spec_round"):
+        "ledgered: ContinuousBatcher.step",
+    ("models/generate.py", "_spec_admit"):
+        "ledgered: ContinuousBatcher.admit",
+    ("models/generate.py", "_spec_import_row"):
+        "ledgered: admit_prefilled / kvstore import",
+    ("models/generate.py", "_spec_suffix_prefill"):
+        "ledgered: cached-prefix suffix prefill",
+    # exempt: one-shot or deliberately unledgered edges, with reasons
+    ("models/generate.py", "_prefill_cache"):
+        "exempt: chunked-prefill helper, inner edge of ledgered entries",
+    ("models/generate.py", "_chunk_step"):
+        "exempt: chunked-prefill helper, inner edge of ledgered entries",
+    ("models/generate.py", "_spec_batched_run"):
+        "exempt: one-dispatch offline path, not the serving loop",
+    ("models/generate.py", "_chunk_probs"):
+        "exempt: offline eval utility (perplexity chunks)",
+    ("ops/quant.py", "_int8_matmul_kernel_call"):
+        "exempt: kernel micro-dispatch, traced via quant/* instants",
+    ("observe/meter.py", "_launch_in_step"):
+        "exempt: MFU meter's own probe, must not perturb the ledger",
+    ("parallel/mpmd.py", "__init__"):
+        "exempt: per-stage MPMD programs, single compile at stage build",
+    ("parallel/multihost.py", "_replicate_fn"):
+        "exempt: one-shot replication helper at setup",
+    ("core/module.py", "materialize"):
+        "exempt: one-shot sharded state init, before any step exists",
+}
+
+
+def _enclosing_context(tree, target):
+    """Name of the nearest enclosing def (or assignment target) holding
+    ``target`` — the stable, line-number-free identity of a jit site."""
+    class _Finder(ast.NodeVisitor):
+        def __init__(self):
+            self.stack = []
+            self.found = None
+
+        def generic_visit(self, node):
+            if node is target:
+                self.found = self.stack[-1] if self.stack else "<module>"
+            if self.found is None:
+                super().generic_visit(node)
+
+        def visit_FunctionDef(self, node):
+            self.stack.append(node.name)
+            self.generic_visit(node)
+            self.stack.pop()
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Assign(self, node):
+            name = node.targets[0].id \
+                if isinstance(node.targets[0], ast.Name) else None
+            if name:
+                self.stack.append(name)
+            self.generic_visit(node)
+            if name:
+                self.stack.pop()
+
+    finder = _Finder()
+    finder.visit(tree)
+    return finder.found or "<module>"
+
+
+def _jit_sites():
+    """Every ``jax.jit`` attribute reference in the library — direct
+    calls, decorators, and ``functools.partial(jax.jit, ...)`` all
+    contain the ``jax.jit`` Attribute node."""
+    sites = set()
+    for dirpath, _dirnames, filenames in os.walk(PKG):
+        for fname in filenames:
+            if not fname.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fname)
+            with open(path) as f:
+                try:
+                    tree = ast.parse(f.read(), filename=path)
+                except SyntaxError:  # pragma: no cover
+                    continue
+            rel = os.path.relpath(path, PKG)
+            for node in ast.walk(tree):
+                if (isinstance(node, ast.Attribute) and node.attr == "jit"
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "jax"):
+                    sites.add((rel, _enclosing_context(tree, node)))
+    return sites
+
+
+@pytest.mark.goodput
+def test_every_jit_site_is_ledgered_or_exempt():
+    found = _jit_sites()
+    known = set(KNOWN_JIT_SITES)
+    new = sorted(found - known)
+    stale = sorted(known - found)
+    assert not new and not stale, (
+        "jax.jit site inventory drifted.\n"
+        "NEW sites (route them through ledger_call, or classify them in "
+        "KNOWN_JIT_SITES with a reason):\n  "
+        + "\n  ".join(f"{p}::{ctx}" for p, ctx in new)
+        + "\nSTALE entries (the site is gone — drop them):\n  "
+        + "\n  ".join(f"{p}::{ctx}" for p, ctx in stale)
+    )
+
+
 @pytest.mark.goodput
 def test_trace_names_follow_slash_convention():
     bad = [
