@@ -51,6 +51,17 @@ request trace so the two disciplines are directly comparable:
   occupancy and the TTFT p50/p95 cold-vs-cached comparison; outputs are
   verified bit-equal between the passes.  ``--kv-bytes`` sets the LRU
   byte budget.  See docs/performance.md ("Prefix cache").
+- ``--mode cache-fleet`` — the prefix cache made FLEET-WIDE
+  (:class:`rocket_tpu.serve.KVPagePool`): two worker PROCESSES share a
+  supervisor-hosted page pool; a seeded multi-turn session runs turn 1
+  on its sticky worker, the worker is SIGKILLed mid-conversation, and
+  turn 2 re-routes to the survivor, which imports the session's pages
+  over the pool socket instead of re-prefilling.  Prints turn-2 TTFT
+  local-hit vs pool-transferred vs cold, the pool's byte counters, the
+  transfer's ``serve/kvstore/wire`` goodput charge, and verifies the
+  migrated turn bit-equal to a cold in-process oracle.  ``--kv-bytes``
+  sets the pool byte budget.  See docs/performance.md
+  ("Fleet KV tier").
 - ``--trace`` (implies ``--mode robust``) — arm the structured tracer
   (:mod:`rocket_tpu.observe.trace`): every round/admit/request gets a
   span, the demo prints the p50/p95 queue-wait/TTFT/TPOT/e2e table at
@@ -804,6 +815,191 @@ def run_cache(args, model, draft, params, draft_params, arrivals, prompts):
                 accepted=0, drafted=0)
 
 
+def run_cache_fleet(args, model, draft, params, draft_params, arrivals,
+                    prompts):
+    """Fleet KV page tier (:mod:`rocket_tpu.serve.kvpool`): the prefix
+    cache made FLEET-WIDE across real worker processes.  Two workers
+    share one supervisor-hosted page pool; a seeded multi-turn session
+    runs turn 1 on its sticky worker, the worker is SIGKILLed
+    mid-conversation, and turn 2 lands on the survivor — which has
+    never seen the session and imports the pages over the pool socket
+    instead of re-prefilling.  The demo prints the turn-2 TTFT three
+    ways (local hit / pool-transferred / cold), the pool's byte
+    counters, and verifies the migrated turn bit-equal to an in-process
+    cold oracle.  See docs/performance.md ("Fleet KV tier")."""
+    from rocket_tpu.serve import (
+        Completed, FleetRouter, KVPagePool, ProcReplica, Request,
+        SharedPrefixIndex, WorkerSpec, register_kvpool_source,
+    )
+    from rocket_tpu.testing import workers as tw
+
+    PAGE = 3            # tiny-worker page size: 5 full pages per 16-token turn
+    pool = KVPagePool(page_tokens=PAGE, capacity_bytes=args.kv_bytes)
+    index = SharedPrefixIndex(page_tokens=PAGE)
+    spec = WorkerSpec(
+        builder="rocket_tpu.testing.workers:build_tiny_loop",
+        kwargs={"kvstore_page_tokens": PAGE},
+        kvpool=pool.address,
+    )
+    if args.metrics_port >= 0:
+        register_kvpool_source(pool)
+    print(f"  [kvfleet] page pool listening on {pool.address} "
+          f"(page_tokens={PAGE}, budget {args.kv_bytes} bytes)")
+
+    def spawn(rid):
+        t = time.perf_counter()
+        rep = ProcReplica(spec, rid, prefix_index=index)
+        print(f"  [kvfleet] spawned worker {rid} (pid {rep.pid}) in "
+              f"{time.perf_counter() - t:.1f}s")
+        return rep
+
+    reps = [spawn(f"cf{i}") for i in range(2)]
+    router = FleetRouter(reps, prefix_index=index)
+
+    rng = np.random.default_rng(11)
+
+    def fresh(n=tw.P):
+        return rng.integers(1, tw.VOCAB, size=n).astype(np.int32)
+
+    def drive(rep, req, max_rounds=400):
+        assert rep.submit(req)
+        out = []
+        for _ in range(max_rounds):
+            rep.pump()
+            out.extend(rep.drain_results())
+            if out:
+                return out[0]
+        raise RuntimeError("worker never returned the warmup turn")
+
+    def last_ttft(rep):
+        # the worker ships its cumulative latency histograms each STEP;
+        # the newest ttft sample is the turn that just finished
+        return rep.latency.ttft_ms._samples[-1]
+
+    def serve_turn(rid, prompt, session):
+        t0 = time.perf_counter()
+        assert router.submit(Request(rid=rid, prompt=prompt,
+                                     session=session)) is None
+        results = router.run_until_idle(max_rounds=1_000_000)
+        wall = (time.perf_counter() - t0) * 1e3
+        (res,) = [r for r in results if r.rid == rid]
+        assert isinstance(res, Completed), res
+        (rep,) = [r for r in router.replicas
+                  if r.replica_id == (res.meta or {}).get("replica")]
+        return res, rep, last_ttft(rep), wall
+
+    # warm every executable the measured turns dispatch (8- and
+    # 16-token cold prefill, page import scatter, suffix prefill,
+    # round) so the three TTFTs compare dispatch time, not compile time
+    def warm(rep):
+        tag = f"{rep.replica_id}-{rep.spawns}"
+        w1 = drive(rep, Request(rid=f"warm1-{tag}", prompt=fresh(),
+                                session="warm"))
+        drive(rep, Request(
+            rid=f"warm2-{tag}",
+            prompt=np.asarray(w1.tokens)[:16].astype(np.int32),
+            session="warm"))
+        drive(rep, Request(rid=f"warm3-{tag}", prompt=fresh(16),
+                           session="warm"))
+
+    print("  [kvfleet] warming both workers (throwaway 3-turn session "
+          "each)...")
+    for rep in reps:
+        warm(rep)
+
+    t_run = time.perf_counter()
+    walls = []
+
+    # -- cold reference: a 16-token prompt no store or pool has seen --
+    _, _, ttft_cold, wall = serve_turn("C1", fresh(16), "cold")
+    walls.append(wall)
+
+    # -- local-hit oracle: both turns stay on the sticky worker --------
+    r_l1, _, _, wall = serve_turn("L1", fresh(), "local")
+    walls.append(wall)
+    p2_local = np.asarray(r_l1.tokens)[:16].astype(np.int32)
+    _, rep_l, ttft_local, wall = serve_turn("L2", p2_local, "local")
+    walls.append(wall)
+    print(f"  [kvfleet] session 'local': both turns on "
+          f"{rep_l.replica_id} — turn-2 served from its own store")
+
+    # -- migration: kill the sticky worker between the turns -----------
+    r_m1, _, _, wall = serve_turn("M1", fresh(), "mig")
+    walls.append(wall)
+    sticky_id = router._affinity["mig"]
+    (sticky,) = [r for r in reps if r.replica_id == sticky_id]
+    sticky.kill()
+    deadline = time.monotonic() + 10.0
+    while sticky.proc.poll() is None and time.monotonic() < deadline:
+        time.sleep(0.01)
+    print(f"  [kvfleet] session 'mig': SIGKILLed its sticky worker "
+          f"{sticky_id} mid-conversation (pid reaped)")
+    # let supervision discover the corpse and respawn it BEFORE the next
+    # turn, so the migrated TTFT measures the transfer, not the heal
+    for _ in range(400):
+        router.pump()
+        if router.counters.heals:
+            break
+    print(f"  [kvfleet] supervision healed {sticky_id} "
+          f"({router.counters.heals} heal(s), spawn #{sticky.spawns}); "
+          f"its local page store died with the old process")
+    warm(sticky)
+    p2_mig = np.asarray(r_m1.tokens)[:16].astype(np.int32)
+    r_m2, rep_m, ttft_xfer, wall = serve_turn("M2", p2_mig, "mig")
+    walls.append(wall)
+    total = time.perf_counter() - t_run
+    print(f"  [kvfleet] turn 2 re-routed to {rep_m.replica_id}, whose "
+          f"local store holds no trace of the session — "
+          f"{int(rep_m.counters['pool_hit_tokens'])} prompt tokens "
+          f"came over the pool socket")
+
+    # the migrated turn is a latency tier, never a correctness tier:
+    # verify bit-equal to a store-less, pool-less in-process oracle
+    oracle = tw.build_tiny_loop()
+    try:
+        oracle.submit(Request(rid="o", prompt=p2_mig))
+        (ro,) = oracle.run_until_idle()
+        bit_equal = np.array_equal(np.asarray(r_m2.tokens),
+                                   np.asarray(ro.tokens))
+    finally:
+        oracle.close()
+
+    snap = pool.snapshot()
+    wire_s = (rep_m.collect() or {}).get("goodput", {}).get(
+        "serve/kvstore/wire_s", 0.0)
+    print(f"  [kvfleet] {'turn-2 TTFT':<14} {'local hit':>12} "
+          f"{'transferred':>12} {'cold':>12}")
+    print(f"  [kvfleet] {'':<14} {ttft_local:>10.1f}ms "
+          f"{ttft_xfer:>10.1f}ms {ttft_cold:>10.1f}ms")
+    print("  [kvfleet] (tiny CPU-proxy models: a 16-token prefill is "
+          "nearly free, so the wire cost shows; at real prefill "
+          "lengths the transfer wins — see the slow bench guard in "
+          "tests/test_kvpool_proc.py)")
+    print(f"  [kvfleet] pool moved {int(snap['bytes_moved'])} bytes "
+          f"({int(snap['bytes_in'])} in / {int(snap['bytes_out'])} out), "
+          f"{int(snap['pages'])} pages resident, "
+          f"{int(snap['fetch_hits'])}/{int(snap['fetches'])} fetch hits, "
+          f"{int(snap['nacks'])} nacks, {int(snap['evictions'])} "
+          f"evictions")
+    print(f"  [kvfleet] {rep_m.replica_id} charged {wire_s * 1e3:.1f} ms "
+          f"to the serve/kvstore/wire goodput bucket (transfer wall "
+          f"time, not hidden)")
+    print(f"  [kvfleet] migrated turn bit-equal to cold oracle: "
+          f"{'yes' if bit_equal else 'NO'}")
+
+    router.close()
+    pool.close()
+    if args.metrics_port >= 0:
+        from rocket_tpu.observe.export import unregister_source
+
+        unregister_source("serve_kvpool")
+
+    lat = np.asarray(walls)
+    return dict(lat=lat, total=total,
+                dispatches=int(router.counters.routed), unit="routes",
+                accepted=0, drafted=0, new_tokens=tw.TOTAL - tw.P)
+
+
 def _report(name, res, n_requests):
     lat = res["lat"]
     new = res.get("new_tokens", NEW)
@@ -829,7 +1025,8 @@ def main():
                         help="mean simulated inter-arrival gap")
     parser.add_argument("--mode",
                         choices=("group", "continuous", "both", "robust",
-                                 "fleet", "fleet-proc", "cache"),
+                                 "fleet", "fleet-proc", "cache",
+                                 "cache-fleet"),
                         default="both")
     parser.add_argument("--autoscale", action="store_true",
                         help="[fleet-proc] start at ONE worker process "
@@ -906,10 +1103,14 @@ def main():
     prompts = rng.integers(0, VOCAB, size=(args.requests, PROMPT))
     max_seq = (CACHE_PROMPT + NEW + NDRAFT if args.mode == "cache"
                else PROMPT + NEW + NDRAFT)
-    if args.mode == "fleet-proc":
+    if args.mode in ("fleet-proc", "cache-fleet"):
         # worker subprocesses build their own tiny models from a
         # WorkerSpec — nothing big to construct in this process
         model = draft = params = draft_params = None
+    if args.mode == "cache-fleet":
+        # the mode runs a scripted 5-request session trace (cold +
+        # local 2-turn + migrated 2-turn); --requests is ignored
+        args.requests = 5
     else:
         model, draft, params, draft_params = _build(max_seq=max_seq)
 
@@ -927,7 +1128,8 @@ def main():
 
     runners = {"group": run_group, "continuous": run_continuous,
                "robust": run_robust, "fleet": run_fleet,
-               "fleet-proc": run_fleet_proc, "cache": run_cache}
+               "fleet-proc": run_fleet_proc, "cache": run_cache,
+               "cache-fleet": run_cache_fleet}
     modes = ["group", "continuous"] if args.mode == "both" else [args.mode]
     results = {}
     try:

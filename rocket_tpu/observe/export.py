@@ -27,7 +27,11 @@ percentiles is meaningless, and the conservative fleet-wide answer to
 "what is my p99" from per-replica p99s is the worst replica.  This is
 documented, not hidden: exact fleet percentiles require merging the
 histograms themselves (``ServeLatency.merge``), which the router already
-does live.
+does live.  The fleet page pool's occupancy/capacity gauges
+(``serve_kvpool/...``) also take the MAX: there is ONE pool, so
+per-snapshot copies of its occupancy must not sum — unlike the
+per-replica ``serve_kvstore`` occupancies, which are genuinely
+distinct stores and do.
 
 No third-party dependency anywhere — ``http.server`` + ``json`` only.
 """
@@ -97,11 +101,15 @@ def collect() -> Dict[str, float]:
 # -- merge across replicas / hosts -------------------------------------------
 
 _PERCENTILE_KEY = re.compile(r"/p\d+$")
+# The page pool is a singleton: its occupancy/capacity gauges appear in
+# every snapshot file but describe ONE store — MAX, never SUM.
+_POOL_GAUGE_KEY = re.compile(r"^serve_kvpool/.*(occupancy|capacity)_bytes$")
 
 
 def merge_counters(snapshots: List[Dict[str, float]]) -> Dict[str, float]:
-    """Fold per-replica/per-host flat snapshots into one: counters sum,
-    percentile keys take the max (worst replica — see module docstring)."""
+    """Fold per-replica/per-host flat snapshots into one: counters sum;
+    percentile keys and the pool's occupancy/capacity gauges take the
+    max (see module docstring)."""
     out: Dict[str, float] = {}
     for snap in snapshots:
         for key, value in snap.items():
@@ -109,7 +117,8 @@ def merge_counters(snapshots: List[Dict[str, float]]) -> Dict[str, float]:
                 value = float(value)
             except (TypeError, ValueError):
                 continue
-            if key in out and _PERCENTILE_KEY.search(key):
+            if key in out and (_PERCENTILE_KEY.search(key)
+                               or _POOL_GAUGE_KEY.match(key)):
                 out[key] = max(out[key], value)
             else:
                 out[key] = out.get(key, 0.0) + value

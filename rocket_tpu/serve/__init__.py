@@ -13,6 +13,11 @@ from rocket_tpu.serve.autoscale import (
     successive_halving_capacity,
 )
 from rocket_tpu.serve.fleet import PrefillReplica, Replica
+from rocket_tpu.serve.kvpool import (
+    KVPagePool,
+    KVPoolClient,
+    register_kvpool_source,
+)
 from rocket_tpu.serve.kvstore import (
     PrefixKVStore,
     PrefixMatch,
@@ -61,6 +66,8 @@ __all__ = [
     "FleetCounters",
     "FleetRouter",
     "HealthState",
+    "KVPagePool",
+    "KVPoolClient",
     "Overloaded",
     "PrefillReplica",
     "PrefixKVStore",
@@ -78,6 +85,7 @@ __all__ = [
     "WorkerSpec",
     "page_hashes",
     "register_fleet_source",
+    "register_kvpool_source",
     "register_kvstore_source",
     "successive_halving_capacity",
 ]

@@ -303,16 +303,28 @@ class PrefillReplica:
     prefill on its own batcher, and delivers the resulting
     :class:`~rocket_tpu.models.generate.KVHandoff` to the router's
     ``deliver(kind, req, payload)`` callback (``kind`` in ``{"handoff",
-    "shed"}``).  The batcher is never :meth:`start`-ed — the prefill
-    lane owns no decode rows."""
+    "shed", "pages"}``).  The batcher is never :meth:`start`-ed — the
+    prefill lane owns no decode rows.
+
+    ``kvpool`` (a :class:`~rocket_tpu.serve.kvpool.KVPoolClient`) plus
+    ``page_tokens`` arm CROSS-PROCESS disaggregation: the handoff's
+    pages push to the fleet pool and only a lightweight ``"pages"``
+    delivery reaches the router — the decode replica (any process)
+    imports the chain from the pool on admit, so the prefilled KV never
+    rides a pickled SUBMIT frame.  Push failure falls back to the
+    in-process ``"handoff"`` delivery."""
 
     def __init__(self, batcher_factory: Callable[[], Any],
                  replica_id: ReplicaId, *, capacity: int = 64,
                  clock: Callable[[], float] = time.monotonic,
                  tracer: Optional[Any] = None,
-                 logger: Optional[logging.Logger] = None) -> None:
+                 logger: Optional[logging.Logger] = None,
+                 kvpool: Optional[Any] = None,
+                 page_tokens: Optional[int] = None) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if kvpool is not None and not page_tokens:
+            raise ValueError("kvpool requires page_tokens")
         self.replica_id = replica_id
         self._factory = batcher_factory
         self.capacity = int(capacity)
@@ -326,6 +338,8 @@ class PrefillReplica:
         self._lock = threading.RLock()
         self._thread: Optional[threading.Thread] = None
         self._stop: Optional[threading.Event] = None
+        self._kvpool = kvpool
+        self._page_tokens = int(page_tokens) if page_tokens else None
         self._bat = self._factory()
 
     @property
@@ -397,11 +411,44 @@ class PrefillReplica:
                     self._pending.appendleft(req)  # salvageable
                 self._dead = f"prefill failed: {exc!r}"
                 return False
+            if self._kvpool is not None:
+                nbytes = self._push_pages(handoff)
+                if nbytes is not None:
+                    self._deliver("pages", req, nbytes)
+                    return True
             self._deliver("handoff", req, handoff)
             return True
         finally:
             with self._lock:
                 self._inflight -= 1
+
+    def _push_pages(self, handoff: Any) -> Optional[int]:
+        """Push a handoff's pages to the fleet pool; returns the chain's
+        byte size on success, ``None`` on any failure (the caller falls
+        back to the in-process handoff delivery — disaggregation through
+        the pool is an accelerant, never a correctness dependency)."""
+        try:
+            if not getattr(self._bat, "prefix_cache_ok", False):
+                return None
+            from rocket_tpu.serve.kvstore import page_hashes
+
+            host = handoff.to_host()
+            pages = host.split_pages(self._page_tokens)
+            if not pages:
+                return None  # prompt shorter than one page: handoff wins
+            import numpy as np
+            hashes = page_hashes(
+                np.asarray(host.buf)[0], self._page_tokens,
+                limit=int(np.asarray(host.n_tok)[0]) - 1,
+            )[:len(pages)]
+            self._kvpool.push(hashes, pages)
+            if getattr(self._kvpool, "_dead", False):
+                return None  # push went nowhere; ship the handoff instead
+            return int(sum(p.nbytes for p in pages))
+        except Exception:
+            self._log.warning("fleet: prefill pool push failed",
+                              exc_info=True)
+            return None
 
     def heal(self) -> Tuple[List[Any], List[Request]]:
         """Rebuild the batcher; pending (never-prefilled) requests are

@@ -1,0 +1,408 @@
+"""Fleet KV page tier — cross-process prefix-page transfer.
+
+PR 15 made prefix KV reuse pay inside one process; the
+:class:`~rocket_tpu.serve.kvstore.SharedPrefixIndex` taught the router
+*where* pages live.  This module makes that hint actionable across
+process boundaries: a supervisor-hosted :class:`KVPagePool` holds
+finished rows' pages fleet-wide, and any replica's
+:class:`KVPoolClient` can import another replica's prefix by hash chain
+instead of re-prefilling — the admit ladder becomes local store →
+pool fetch → cold prefill.
+
+- **Protocol** — three message kinds over :mod:`rocket_tpu.utils.
+  framing` (the fleet's one transport discipline): ``PUSH_PAGES``
+  carries a binary page-chain blob pool-ward, ``FETCH_PAGES`` asks for
+  the longest stored prefix of a hash chain and gets back ``PAGES`` (a
+  blob) or ``PAGE_NACK`` (nothing usable — the stale-hint outcome,
+  which costs a cold prefill, never an error).  The pool runs its own
+  listener: page traffic never contends with the one-in-flight
+  supervisor<->worker STEP RPC.
+- **Wire format** — :func:`encode_page_chain` /
+  :func:`decode_page_chain`: a small pickled header (hashes, page
+  count, the pages' shared treedef) plus :func:`~rocket_tpu.utils.
+  framing.pack_arrays` raw ndarray bytes.  No per-page pickling, and
+  int8 pages cross as int8 payload + rank-4 f32 scales — ~2.7x less
+  wire than f32.
+- **Backing store** — the pool reuses :class:`~rocket_tpu.serve.
+  kvstore.PrefixKVStore` (LRU under a byte budget, chain-walk
+  matching, layout pinning) via :meth:`~PrefixKVStore.match_hashes`,
+  so pool eviction and partial-prefix serving need no new machinery.
+- **Accounting** — client-side transfer wall time lands in the
+  ``serve/kvstore/wire`` goodput bucket (:data:`WIRE_BUCKET`); pool
+  counters export via :func:`register_kvpool_source` as
+  ``rocket_tpu_serve_kvpool_*`` Prometheus gauges.
+
+Failure model: the pool is an ACCELERANT.  A dead pool, a socket
+error, a NACK, a layout mismatch — every failure degrades to cold
+prefill; nothing on this path may take a request down.
+"""
+
+from __future__ import annotations
+
+import logging
+import pickle
+import socket
+import struct
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+
+from rocket_tpu.models.generate import KVPage
+from rocket_tpu.serve import wire
+from rocket_tpu.serve.kvstore import PrefixKVStore
+from rocket_tpu.utils.framing import (
+    FramedSocket, address, pack_arrays, parse_address, unpack_arrays,
+)
+
+__all__ = [
+    "WIRE_BUCKET",
+    "KVPagePool",
+    "KVPoolClient",
+    "decode_page_chain",
+    "encode_page_chain",
+    "register_kvpool_source",
+]
+
+# Goodput bucket for page-transfer wall time (client side, i.e. charged
+# to the replica that waited).  Registered in GoodputLedger.BUCKETS so
+# goodput.json always carries "serve/kvstore/wire_s".
+WIRE_BUCKET = "serve/kvstore/wire"
+
+_LEN = struct.Struct("!I")
+
+_log = logging.getLogger("rocket_tpu.serve.kvpool")
+
+
+# -- page-chain codec --------------------------------------------------------
+
+
+def encode_page_chain(hashes: List[bytes],
+                      pages: List[KVPage]) -> bytes:
+    """Encode a contiguous page chain as one binary blob.
+
+    Layout: ``!I`` header length, a pickled header (``hashes``,
+    ``n_pages``, the pages' shared ``treedef``), then the pages'
+    ndarray leaves via :func:`pack_arrays` — page-major, so page ``i``
+    owns leaves ``[i*per, (i+1)*per)``.  All pages of a chain share one
+    treedef (same batcher layout); a mixed chain is a caller bug and
+    raises."""
+    if len(hashes) != len(pages):
+        raise ValueError(
+            f"chain length mismatch: {len(hashes)} hashes, "
+            f"{len(pages)} pages")
+    leaves: List[Any] = []
+    treedef = None
+    for page in pages:
+        flat, td = jax.tree_util.tree_flatten(
+            (page.tokens, page.cache_t, page.cache_d))
+        if treedef is None:
+            treedef = td
+        elif td != treedef:
+            raise ValueError("pages of one chain must share a layout")
+        leaves.extend(flat)
+    header = pickle.dumps(
+        {"hashes": list(hashes), "n_pages": len(pages),
+         "treedef": treedef},
+        protocol=pickle.HIGHEST_PROTOCOL)
+    return _LEN.pack(len(header)) + header + pack_arrays(leaves)
+
+
+def decode_page_chain(data: bytes) -> Tuple[List[bytes], List[KVPage]]:
+    """Decode :func:`encode_page_chain` output bit-exactly into owned
+    host pages (``unpack_arrays`` copies — a cached page must not pin
+    the whole received frame alive)."""
+    (hlen,) = _LEN.unpack_from(data, 0)
+    header = pickle.loads(data[_LEN.size:_LEN.size + hlen])
+    hashes = header["hashes"]
+    n_pages = int(header["n_pages"])
+    treedef = header["treedef"]
+    leaves = unpack_arrays(data[_LEN.size + hlen:])
+    pages: List[KVPage] = []
+    if n_pages:
+        per = len(leaves) // n_pages
+        for i in range(n_pages):
+            tokens, cache_t, cache_d = jax.tree_util.tree_unflatten(
+                treedef, leaves[i * per:(i + 1) * per])
+            pages.append(KVPage(tokens=tokens, cache_t=cache_t,
+                                cache_d=cache_d))
+    return hashes, pages
+
+
+# -- the pool service --------------------------------------------------------
+
+
+class KVPagePool:
+    """Supervisor-hosted page-pool server.
+
+    Binds ``host:port`` (``port=0`` = ephemeral), accepts any number of
+    replica clients, and answers each connection on its own daemon
+    thread — strictly request/reply per connection, so a client's
+    one-in-flight discipline holds end to end.  Backing storage is a
+    :class:`PrefixKVStore` (LRU, byte budget, layout pinning); a fetch
+    pins its match only while encoding, so pool eviction can never
+    corrupt an in-flight transfer.
+
+    ``snapshot()`` returns flat float counters (fetches / pushes /
+    nacks / bytes moved / occupancy) for the ``serve_kvpool`` export
+    source."""
+
+    def __init__(self, *, page_tokens: int = 16,
+                 capacity_bytes: int = 1 << 30,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        self._store = PrefixKVStore(
+            page_tokens=page_tokens, capacity_bytes=capacity_bytes,
+            name="kvpool")
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(16)
+        self.host = host
+        self.port = int(self._srv.getsockname()[1])
+        self._lock = threading.Lock()
+        self._closed = False
+        self._conns: List[FramedSocket] = []
+        self.fetches = 0
+        self.fetch_hits = 0
+        self.nacks = 0
+        self.pushes = 0
+        self.pages_pushed = 0
+        self.bytes_in = 0
+        self.bytes_out = 0
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="kvpool-accept", daemon=True)
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> str:
+        """``"host:port"`` — what WorkerSpec.kvpool carries."""
+        return address(self.host, self.port)
+
+    @property
+    def page_tokens(self) -> int:
+        return self._store.page_tokens
+
+    # -- server plumbing -----------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._closed:
+            try:
+                conn, _addr = self._srv.accept()
+            except OSError:
+                return  # listener closed
+            fs = FramedSocket(conn)
+            with self._lock:
+                self._conns.append(fs)
+            threading.Thread(target=self._serve_conn, args=(fs,),
+                             name="kvpool-conn", daemon=True).start()
+
+    def _serve_conn(self, fs: FramedSocket) -> None:
+        try:
+            while not self._closed:
+                try:
+                    kind, payload = wire.recv_msg(fs, timeout=5.0)
+                except TimeoutError:
+                    continue  # idle client; partial frames stay buffered
+                except (ConnectionError, OSError, EOFError):
+                    return
+                try:
+                    self._handle(fs, kind, payload)
+                except (ConnectionError, OSError):
+                    return
+                except Exception as exc:  # reply, never die
+                    _log.warning("kvpool: request failed", exc_info=True)
+                    try:
+                        wire.send_msg(fs, wire.ERROR, repr(exc))
+                    except OSError:
+                        return
+        finally:
+            fs.close()
+
+    def _handle(self, fs: FramedSocket, kind: str, payload: Any) -> None:
+        if kind == wire.PUSH_PAGES:
+            hashes, pages = decode_page_chain(payload)
+            stored = self._store.put_pages(hashes, pages)
+            with self._lock:
+                self.pushes += 1
+                self.pages_pushed += stored
+                self.bytes_in += len(payload)
+            wire.send_msg(fs, wire.REPLY, {"stored": stored})
+        elif kind == wire.FETCH_PAGES:
+            hashes = payload["hashes"]
+            with self._lock:
+                self.fetches += 1
+            match = self._store.match_hashes(hashes)
+            if match is None:
+                with self._lock:
+                    self.nacks += 1
+                wire.send_msg(fs, wire.PAGE_NACK, None)
+                return
+            try:
+                blob = encode_page_chain(match.hashes, match.pages)
+            finally:
+                self._store.release(match)
+            with self._lock:
+                self.fetch_hits += 1
+                self.bytes_out += len(blob)
+            wire.send_msg(fs, wire.PAGES, blob)
+        elif kind == wire.PING:
+            wire.send_msg(fs, wire.PONG, None)
+        else:
+            raise ValueError(f"kvpool: unknown message kind {kind!r}")
+
+    # -- observability / teardown --------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat float counters; ``occupancy_bytes``/``capacity_bytes``
+        are gauges (merge with MAX across snapshots of the same pool,
+        which ``observe.export.merge_counters`` knows)."""
+        store = self._store.snapshot()
+        with self._lock:
+            return {
+                "fetches": float(self.fetches),
+                "fetch_hits": float(self.fetch_hits),
+                "nacks": float(self.nacks),
+                "pushes": float(self.pushes),
+                "pages_pushed": float(self.pages_pushed),
+                "bytes_in": float(self.bytes_in),
+                "bytes_out": float(self.bytes_out),
+                "bytes_moved": float(self.bytes_in + self.bytes_out),
+                "occupancy_bytes": store["occupancy_bytes"],
+                "capacity_bytes": store["capacity_bytes"],
+                "pages": store["pages"],
+                "evictions": store["evictions"],
+            }
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for fs in conns:
+            fs.close()
+
+
+# -- the replica-side client -------------------------------------------------
+
+
+class KVPoolClient:
+    """One replica's connection to the fleet page pool.
+
+    Strictly one-in-flight request/reply under a lock (same discipline
+    as the supervisor RPC).  Every failure path — dead pool, timeout,
+    NACK — returns ``None``/``0``: the pool is an accelerant and the
+    caller always has cold prefill.  After a socket error the client
+    marks itself dead and short-circuits, so a crashed pool costs one
+    timeout, not one per admission.
+
+    ``push`` dedupes client-side: a chain whose hashes were all pushed
+    before is skipped without touching the wire.  A NACK clears the
+    dedup set — the pool evicting our pages means "pushed before" no
+    longer implies "present"."""
+
+    def __init__(self, fs: FramedSocket, *,
+                 timeout: float = 30.0) -> None:
+        self._fs = fs
+        self._timeout = float(timeout)
+        self._lock = threading.Lock()
+        self._dead = False
+        self._pushed: set = set()
+        self.fetches = 0
+        self.hits = 0
+        self.nacks = 0
+        self.pushes = 0
+        self.bytes_moved = 0
+
+    @classmethod
+    def connect(cls, addr: str, *, timeout: float = 30.0
+                ) -> "KVPoolClient":
+        host, port = parse_address(addr)
+        return cls(FramedSocket.connect(host, port, timeout=timeout),
+                   timeout=timeout)
+
+    def _rpc(self, kind: str, payload: Any) -> Tuple[str, Any]:
+        wire.send_msg(self._fs, kind, payload)
+        return wire.recv_msg(self._fs, self._timeout)
+
+    def fetch(self, hashes: List[bytes]) -> Optional[List[KVPage]]:
+        """Longest pooled prefix of ``hashes`` as owned host pages, or
+        ``None`` (NACK / error / dead pool).  Wall time is charged to
+        the ``serve/kvstore/wire`` goodput bucket."""
+        if self._dead or not hashes:
+            return None
+        from rocket_tpu.observe.ledger import get_goodput
+        with self._lock:
+            self.fetches += 1
+            try:
+                with get_goodput().timed(WIRE_BUCKET):
+                    kind, payload = self._rpc(
+                        wire.FETCH_PAGES, {"hashes": list(hashes)})
+            except (ConnectionError, OSError, EOFError, ValueError):
+                _log.warning("kvpool: fetch failed; disabling client",
+                             exc_info=True)
+                self._dead = True
+                return None
+            if kind != wire.PAGES:
+                self.nacks += 1
+                # our pushes may have been evicted pool-side; re-push
+                self._pushed.clear()
+                return None
+            self.bytes_moved += len(payload)
+            _hashes, pages = decode_page_chain(payload)
+            self.hits += 1
+            return pages
+
+    def push(self, hashes: List[bytes], pages: List[KVPage]) -> int:
+        """Offer a page chain to the pool; returns pages newly stored
+        pool-side (0 on dedup skip / error / dead pool)."""
+        if self._dead or not pages:
+            return 0
+        from rocket_tpu.observe.ledger import get_goodput
+        with self._lock:
+            if all(h in self._pushed for h in hashes):
+                return 0
+            try:
+                blob = encode_page_chain(hashes, pages)
+                with get_goodput().timed(WIRE_BUCKET):
+                    kind, payload = self._rpc(wire.PUSH_PAGES, blob)
+            except (ConnectionError, OSError, EOFError, ValueError):
+                _log.warning("kvpool: push failed; disabling client",
+                             exc_info=True)
+                self._dead = True
+                return 0
+            if kind != wire.REPLY:
+                return 0
+            self.pushes += 1
+            self.bytes_moved += len(blob)
+            self._pushed.update(hashes)
+            return int(payload.get("stored", 0))
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return {
+                "fetches": float(self.fetches),
+                "hits": float(self.hits),
+                "nacks": float(self.nacks),
+                "pushes": float(self.pushes),
+                "bytes_moved": float(self.bytes_moved),
+            }
+
+    def close(self) -> None:
+        self._dead = True
+        self._fs.close()
+
+
+def register_kvpool_source(pool: KVPagePool,
+                           name: str = "serve_kvpool") -> str:
+    """Register the pool's snapshot as an ``observe.export`` source so
+    ``/metrics`` serves ``rocket_tpu_serve_kvpool_*`` gauges.  Counters
+    merge by SUM across snapshot files; ``occupancy_bytes`` /
+    ``capacity_bytes`` merge by MAX (they are gauges of one pool, not
+    per-replica deltas).  Returns the source name."""
+    from rocket_tpu.observe.export import register_source
+
+    register_source(name, pool.snapshot)
+    return name

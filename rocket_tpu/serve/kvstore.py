@@ -158,6 +158,9 @@ class PrefixKVStore:
         # hashes stored since the last drain — the delta a process-backed
         # replica ships to the fleet's SharedPrefixIndex each step
         self._fresh: List[bytes] = []
+        # hashes evicted since the last drain — the anti-delta, so the
+        # supervisor can forget() stale claims instead of stranding them
+        self._fresh_evicted: List[bytes] = []
 
     def __len__(self) -> int:
         return len(self._table)
@@ -172,6 +175,13 @@ class PrefixKVStore:
         toks = np.asarray(tokens, np.int32).reshape(-1)
         hashes = page_hashes(toks, self.page_tokens,
                              limit=toks.shape[0] - 1)
+        return self.match_hashes(hashes)
+
+    def match_hashes(self, hashes: List[bytes]) -> Optional[PrefixMatch]:
+        """Longest stored prefix of an already-hashed chain — the same
+        pin/touch/counter discipline as :meth:`lookup`, keyed by hash so
+        the fleet page pool can serve ``FETCH_PAGES`` without ever
+        seeing the tokens.  ``None`` on a total miss."""
         with self._lock:
             self.lookups += 1
             matched: List[bytes] = []
@@ -297,6 +307,7 @@ class PrefixKVStore:
             self.occupancy_bytes -= entry.nbytes
             self.evictions += 1
             self.evicted_bytes += entry.nbytes
+            self._fresh_evicted.append(victim)
             self._tracer.counter("serve/kvstore/evict", 1,
                                  nbytes=entry.nbytes)
         return True
@@ -319,6 +330,17 @@ class PrefixKVStore:
         holds which prefix without the pages ever crossing."""
         with self._lock:
             out, self._fresh = self._fresh, []
+        return out
+
+    def drain_evicted_hashes(self) -> List[bytes]:
+        """Return-and-clear the hashes EVICTED since the last drain —
+        the staleness feedback the STEP reply carries so the
+        supervisor's :class:`SharedPrefixIndex` can :meth:`~
+        SharedPrefixIndex.forget` this replica's dead claims (otherwise
+        a worker-side eviction silently strands supervisor-side hints).
+        """
+        with self._lock:
+            out, self._fresh_evicted = self._fresh_evicted, []
         return out
 
     # -- observability -------------------------------------------------
@@ -376,6 +398,7 @@ class SharedPrefixIndex:
         self.queries = 0
         self.routed = 0
         self.invalidations = 0
+        self.pages_stale = 0
 
     def __len__(self) -> int:
         return len(self._where)
@@ -402,6 +425,26 @@ class SharedPrefixIndex:
                 del self._where[h]
             if dropped:
                 self.invalidations += 1
+            return dropped
+
+    def forget(self, replica_id: Any, hashes: Iterable[bytes]) -> int:
+        """Drop a replica's claims on SPECIFIC hashes — the per-step
+        staleness feedback from its store's eviction drain.  Without
+        this a worker-side eviction strands the supervisor-side hint
+        forever; with it the hint degrades to a NACK + cold prefill and
+        the ``pages_stale`` counter records how often eviction raced a
+        route.  Returns the number of claims dropped."""
+        with self._lock:
+            dropped = 0
+            for h in hashes:
+                holders = self._where.get(h)
+                if holders is None or replica_id not in holders:
+                    continue
+                holders.discard(replica_id)
+                dropped += 1
+                if not holders:
+                    del self._where[h]
+            self.pages_stale += dropped
             return dropped
 
     def best_replica(self, tokens) -> Optional[Any]:
@@ -438,6 +481,7 @@ class SharedPrefixIndex:
                 "queries": float(self.queries),
                 "routed": float(self.routed),
                 "invalidations": float(self.invalidations),
+                "pages_stale": float(self.pages_stale),
             }
 
 

@@ -50,6 +50,7 @@ from rocket_tpu.models.generate import export_kv_row
 from rocket_tpu.observe.ledger import expect_compile, get_goodput
 from rocket_tpu.observe.recorder import active_recorder
 from rocket_tpu.observe.trace import get_tracer
+from rocket_tpu.serve.kvstore import page_hashes
 from rocket_tpu.serve.metrics import ServeCounters, ServeLatency
 from rocket_tpu.serve.policy import DegradationPolicy
 from rocket_tpu.serve.queue import AdmissionQueue
@@ -119,6 +120,12 @@ class ServingLoop:
     prefix and prefill only the uncached suffix, retiring rows export
     their pages back — outputs stay bit-equal to serving without the
     store.
+    ``kvpool`` (a :class:`~rocket_tpu.serve.kvpool.KVPoolClient`;
+    requires ``kvstore``) arms the FLEET page tier on top: an
+    admit-miss consults the pool before cold prefill (local store →
+    pool fetch → cold — a NACK only costs the prefill we were about to
+    pay anyway), and retiring rows push their pages pool-ward so other
+    replicas can import them.
     """
 
     def __init__(
@@ -140,6 +147,7 @@ class ServingLoop:
         kv_cache_int8: Optional[bool] = None,
         replica_id: Optional[str] = None,
         kvstore: Optional[Any] = None,
+        kvpool: Optional[Any] = None,
         warmup: Optional[Any] = None,
     ) -> None:
         if max_batch < 1:
@@ -202,6 +210,13 @@ class ServingLoop:
         # Admission looks up the longest cached prefix and prefills only
         # the uncached suffix; completing rows export their pages back.
         self.kvstore = kvstore
+        # Fleet page tier (ISSUE 16): a KVPoolClient consulted on local
+        # admit-miss and fed on retire.  Strictly an accelerant — every
+        # pool failure degrades to cold prefill.
+        if kvpool is not None and kvstore is None:
+            raise ValueError("kvpool requires kvstore (pages land in the "
+                             "local store before admission imports them)")
+        self.kvpool = kvpool
 
         self._bat = self._build_batcher()
         self.base_n_draft = int(self._bat.n_draft)
@@ -288,6 +303,11 @@ class ServingLoop:
     def close(self) -> None:
         self._flush(force=True)
         self.watchdog.close()
+        if self.kvpool is not None:
+            try:
+                self.kvpool.close()
+            except Exception:
+                pass
 
     # -- submission ----------------------------------------------------
 
@@ -474,6 +494,8 @@ class ServingLoop:
         match = None
         if handoff is None and self.kvstore is not None:
             match = self.kvstore.lookup(prompt)
+            if match is None and self.kvpool is not None:
+                match = self._pool_fetch(prompt)
         # The admit IS the row's prefill (the batcher rebuilds the row's
         # cache from the prompt) — one span covers admission + prefill.
         # A handed-off request skips the prefill: its KV rows import as
@@ -507,6 +529,32 @@ class ServingLoop:
         self._rows[row] = _Row(req, now, prompt.shape[0], budget,
                                requested, demoted, submitted_at=submitted)
         self.counters.admitted += 1
+
+    def _pool_fetch(self, prompt: np.ndarray) -> Optional[Any]:
+        """Local admit-miss → consult the fleet page pool.  Fetched
+        pages land in the LOCAL store first (put_pages), then a normal
+        lookup pins them — admission then proceeds exactly as a local
+        hit, so bit-equality and pin discipline need no second path.
+        Any failure (NACK, dead pool, layout mismatch) returns ``None``
+        and the admit falls through to cold prefill."""
+        try:
+            hashes = page_hashes(prompt, self.kvstore.page_tokens,
+                                 limit=int(prompt.shape[0]) - 1)
+            if not hashes:
+                return None
+            pages = self.kvpool.fetch(hashes)
+            if not pages:
+                self.counters.pool_nacks += 1
+                return None
+            self.kvstore.put_pages(hashes[:len(pages)], pages)
+            match = self.kvstore.lookup(prompt)
+            if match is not None:
+                self.counters.pool_hits += 1
+                self.counters.pool_hit_tokens += match.tokens
+            return match
+        except Exception:
+            self._log.warning("serve: kvpool fetch failed", exc_info=True)
+            return None
 
     def _serve_beam(self, req: Request, now: float) -> None:
         """Level-0 beam lane: one inline beam call (its own prefill,
@@ -708,7 +756,24 @@ class ServingLoop:
             return
         try:
             with self._tracer.span("serve/kvstore_export", row=row):
-                self.kvstore.insert(export_kv_row(self._bat.state, row))
+                if self.kvpool is None:
+                    self.kvstore.insert(export_kv_row(self._bat.state, row))
+                    return
+                # Pool-armed path: split/hash ONCE, feed both tiers —
+                # local store for this replica's next hit, pool push so
+                # any other replica can import the chain.
+                host = export_kv_row(self._bat.state, row).to_host()
+                pt = self.kvstore.page_tokens
+                pages = host.split_pages(pt)
+                if not pages:
+                    return
+                hashes = page_hashes(
+                    np.asarray(host.buf)[0], pt,
+                    limit=int(np.asarray(host.n_tok)[0]) - 1,
+                )[:len(pages)]
+                self.kvstore.put_pages(hashes, pages)
+                self.counters.pool_pushed_pages += \
+                    self.kvpool.push(hashes, pages)
         except Exception:
             self._log.warning("serve: kvstore export failed",
                               exc_info=True)

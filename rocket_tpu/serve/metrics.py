@@ -25,6 +25,10 @@ class ServeCounters:
         self.prefilled_admits = 0   # admissions that imported a KVHandoff
         self.kv_hits = 0            # admissions served from the prefix cache
         self.kv_hit_tokens = 0      # prompt tokens skipped via cached pages
+        self.pool_hits = 0          # admissions served via fleet pool fetch
+        self.pool_hit_tokens = 0    # prompt tokens skipped via pooled pages
+        self.pool_nacks = 0         # pool consulted, nothing usable (stale)
+        self.pool_pushed_pages = 0  # pages this loop pushed pool-ward
         self.completed = 0
         self.shed_overload = 0      # bounded-queue / draining rejections
         self.shed_deadline = 0      # shed before prefill (stage='queue')
@@ -58,6 +62,10 @@ class ServeCounters:
             "prefilled_admits": float(self.prefilled_admits),
             "kv_hits": float(self.kv_hits),
             "kv_hit_tokens": float(self.kv_hit_tokens),
+            "pool_hits": float(self.pool_hits),
+            "pool_hit_tokens": float(self.pool_hit_tokens),
+            "pool_nacks": float(self.pool_nacks),
+            "pool_pushed_pages": float(self.pool_pushed_pages),
             "completed": float(self.completed),
             "shed_overload": float(self.shed_overload),
             "shed_deadline": float(self.shed_deadline),
@@ -126,6 +134,7 @@ class FleetCounters:
         self.affinity_routed = 0    # session requests routed to their replica
         self.affinity_invalidated = 0   # session stamps dropped by a heal
         self.pages_routed = 0       # routed by the shared prefix-hash index
+        self.pool_handoffs = 0      # prefill->decode via the fleet page pool
         self.replicas_added = 0     # autoscaler spawns joined to the fleet
         self.replicas_retired = 0   # replicas drained out of the fleet
 
@@ -142,6 +151,7 @@ class FleetCounters:
             "affinity_routed": float(self.affinity_routed),
             "affinity_invalidated": float(self.affinity_invalidated),
             "pages_routed": float(self.pages_routed),
+            "pool_handoffs": float(self.pool_handoffs),
             "replicas_added": float(self.replicas_added),
             "replicas_retired": float(self.replicas_retired),
         }

@@ -289,6 +289,11 @@ class ProcReplica:
         hashes = reply.get("kv_hashes")
         if hashes and self._prefix_index is not None:
             self._prefix_index.note(self.replica_id, hashes)
+        # staleness feedback: hashes the worker's store evicted this step
+        # — forget the claims so a hint never points at a dead chain
+        evicted = reply.get("kv_evicted")
+        if evicted and self._prefix_index is not None:
+            self._prefix_index.forget(self.replica_id, evicted)
         return bool(reply.get("busy"))
 
     def drain_results(self) -> List[Any]:

@@ -206,6 +206,16 @@ class FleetRouter:
                     meta={"replica": None, "level": None},
                 ))
                 return
+            if kind == "pages":
+                # cross-process disaggregation: the prefilled KV sits in
+                # the fleet pool; route the bare request and let the
+                # decode replica's admit ladder import the chain (a pool
+                # miss there only costs the cold prefill we skipped).
+                self.counters.pool_handoffs += 1
+                self._instant("fleet/pool_handoff", rid=req.rid,
+                              nbytes=int(payload or 0))
+                self._route_decode(req)
+                return
             handoff = payload
             self.counters.handoffs += 1
             self.counters.handoff_bytes += int(handoff.nbytes)

@@ -50,6 +50,15 @@ RENAME = "rename"        # re-stamp the worker's fleet identity (a warm
 REPLY = "reply"          # generic success reply
 ERROR = "error"          # worker -> supervisor: payload is the repr
 
+# Fleet KV page tier (serve/kvpool.py).  These cross between a replica's
+# KVPoolClient and the supervisor-hosted KVPagePool, NOT on the
+# supervisor<->worker RPC socket — the pool runs its own listener so a
+# mid-decode page fetch never contends with the one-in-flight STEP RPC.
+FETCH_PAGES = "fetch_pages"  # client -> pool: {"hashes": [bytes, ...]}
+PUSH_PAGES = "push_pages"    # client -> pool: binary page-chain blob
+PAGES = "pages"              # pool -> client: binary page-chain blob
+PAGE_NACK = "page_nack"      # pool -> client: no usable prefix (stale hint)
+
 
 def send_msg(fs: FramedSocket, kind: str, payload: Any = None) -> None:
     fs.send_obj((kind, payload))
@@ -78,11 +87,16 @@ class WorkerSpec:
     elastic-restore path: the builder restores params from the newest
     valid snapshot under it (validated by ``check_reshard`` against
     whatever devices this worker got) instead of seeding them.
+    ``kvpool`` is the supervisor-hosted page pool's ``"host:port"``
+    address; when set (and the built loop has a kvstore), the worker
+    attaches a :class:`~rocket_tpu.serve.kvpool.KVPoolClient` so
+    admit-misses consult the fleet tier before cold prefill.
     """
 
     builder: str
     kwargs: Optional[Dict[str, Any]] = None
     restore_dir: Optional[str] = None
+    kvpool: Optional[str] = None
 
     def resolve(self) -> Callable[..., Any]:
         mod_name, sep, attr = self.builder.partition(":")

@@ -140,6 +140,9 @@ def serve(fs: FramedSocket, loop: Any, *,
                 }
                 if kvstore is not None:
                     reply["kv_hashes"] = kvstore.drain_new_hashes()
+                    # the anti-delta: evicted hashes, so the supervisor's
+                    # SharedPrefixIndex forgets this replica's dead claims
+                    reply["kv_evicted"] = kvstore.drain_evicted_hashes()
                 wire.send_msg(fs, wire.REPLY, reply)
             elif kind == wire.PING:
                 wire.send_msg(fs, wire.PONG, {
@@ -223,6 +226,18 @@ def main(argv: Optional[list] = None) -> int:
             if args.replica_id is not None:
                 loop.replica_id = args.replica_id
                 loop.queue.name = args.replica_id
+            # Fleet page tier: the spec carries the pool's address; the
+            # client attaches post-build (accelerant — a dead pool means
+            # cold prefills, not a dead worker).  Skip when the builder
+            # already attached a client or the loop has no kvstore.
+            if getattr(spec, "kvpool", None) \
+                    and getattr(loop, "kvstore", None) is not None \
+                    and getattr(loop, "kvpool", None) is None:
+                try:
+                    from rocket_tpu.serve.kvpool import KVPoolClient
+                    loop.kvpool = KVPoolClient.connect(spec.kvpool)
+                except Exception:
+                    pass
         except Exception:
             wire.send_msg(fs, wire.ERROR, traceback.format_exc())
             return 2

@@ -67,6 +67,9 @@ def build_tiny_loop(
     seed_draft: int = SEED_DRAFT,
     restore_dir: Optional[str] = None,
     kvstore_page_tokens: Optional[int] = None,
+    kvstore_bytes: Optional[int] = None,
+    kvpool_addr: Optional[str] = None,
+    kv_cache_int8: Optional[bool] = None,
     watchdog_timeout: Optional[float] = None,
     warmup: Optional[Any] = None,
 ) -> Any:
@@ -76,7 +79,12 @@ def build_tiny_loop(
     elastic restore from the newest valid snapshot under it (the seeded
     tree doubles as the ``check_reshard`` target template).
     ``kvstore_page_tokens`` arms a per-process prefix cache whose new
-    page hashes ship to the supervisor's shared index on every STEP.
+    page hashes ship to the supervisor's shared index on every STEP
+    (``kvstore_bytes`` caps it; default 1 GiB).  ``kvpool_addr``
+    (``"host:port"``) additionally attaches a fleet page-pool client —
+    admit-misses consult the pool before cold prefill; connect failure
+    degrades to pool-less serving.  ``kv_cache_int8`` forces the int8
+    KV-cache layout (pages then travel int8 + rank-4 f32 scales).
     ``warmup`` (``"auto"`` / a WarmupPlan wire dict) arms the AOT
     warm-start tier — plain data, so it rides WorkerSpec kwargs."""
     from rocket_tpu.models.generate import ContinuousBatcher
@@ -97,13 +105,26 @@ def build_tiny_loop(
 
     kvstore = None
     if kvstore_page_tokens is not None:
-        kvstore = PrefixKVStore(page_tokens=int(kvstore_page_tokens))
+        kvstore = PrefixKVStore(
+            page_tokens=int(kvstore_page_tokens),
+            capacity_bytes=int(kvstore_bytes) if kvstore_bytes else 1 << 30,
+        )
+    kvpool = None
+    if kvpool_addr is not None and kvstore is not None:
+        from rocket_tpu.serve.kvpool import KVPoolClient
+
+        try:
+            kvpool = KVPoolClient.connect(kvpool_addr, timeout=30.0)
+        except OSError:
+            kvpool = None  # pool is an accelerant, not a dependency
     return ServingLoop(
         factory,
         max_batch=int(max_batch),
         queue_capacity=int(queue_capacity),
         watchdog_timeout=watchdog_timeout,
+        kv_cache_int8=kv_cache_int8,
         kvstore=kvstore,
+        kvpool=kvpool,
         warmup=warmup,
     )
 

@@ -17,6 +17,12 @@ transport discipline, two protocols on top.
   it, and only then accept — the rendezvous a spawned worker subprocess
   needs (``SocketEndpoint.listen`` keeps its one-shot bind+accept shape
   on top of this).
+- :func:`pack_arrays` / :func:`unpack_arrays` are the zero-copy-ish
+  binary ndarray codec the fleet KV page tier's ``FETCH_PAGES`` /
+  ``PUSH_PAGES`` payloads ride on: a compact header (dtype descr, ndim,
+  shape per array) followed by the raw C-contiguous buffer bytes — no
+  per-array pickling, bit-exact for every dtype numpy can describe
+  (f32 K/V payloads and int8 pages with their rank-4 f32 scales alike).
 """
 
 from __future__ import annotations
@@ -25,11 +31,12 @@ import pickle
 import socket
 import struct
 import time
-from typing import Any, Optional, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 DEFAULT_TIMEOUT_S = 120.0
 
 _LEN = struct.Struct("!I")
+_U8 = struct.Struct("!B")
 
 
 class FramedSocket:
@@ -128,6 +135,69 @@ class FrameListener:
             self._srv.close()
         except OSError:
             pass
+
+
+def pack_arrays(arrays: Sequence[Any]) -> bytes:
+    """Encode ndarrays as one binary blob: compact header + raw bytes.
+
+    Per array the header carries ``!B`` dtype-descr length, the dtype
+    descr string (``np.dtype(descr)`` round-trips it), ``!B`` ndim and
+    ``!Q`` per-dimension sizes; the payload section is the arrays'
+    C-contiguous buffers back to back.  No per-array pickling — the
+    page-transfer path moves megabytes of K/V payload per chain and
+    pickle's memo/opcode overhead (and its extra copy) is pure waste.
+    """
+    import numpy as np
+
+    header = [_LEN.pack(len(arrays))]
+    bufs: List[Any] = []
+    for arr in arrays:
+        a = np.asarray(arr)
+        if not a.flags["C_CONTIGUOUS"]:
+            # NB ascontiguousarray would also promote 0-d to 1-d, but
+            # 0-d arrays are always contiguous so never reach it
+            a = np.ascontiguousarray(a)
+        descr = a.dtype.str.encode("ascii")
+        if len(descr) > 255:
+            raise ValueError(f"dtype descr too long: {a.dtype!r}")
+        if a.ndim > 255:
+            raise ValueError(f"too many dimensions: {a.ndim}")
+        header.append(_U8.pack(len(descr)) + descr + _U8.pack(a.ndim))
+        header.append(struct.pack(f"!{a.ndim}Q", *a.shape))
+        bufs.append(a.data if a.size else b"")
+    return b"".join(header) + b"".join(bufs)
+
+
+def unpack_arrays(data: bytes, copy: bool = True) -> List[Any]:
+    """Decode :func:`pack_arrays` output bit-exactly.
+
+    ``copy=True`` (the default) returns owned writable arrays — callers
+    that cache pages must not pin the whole received frame alive via a
+    read-only ``frombuffer`` view, so copying is the safe default.
+    """
+    import numpy as np
+
+    mv = memoryview(data)
+    (count,) = _LEN.unpack_from(mv, 0)
+    off = _LEN.size
+    metas = []
+    for _ in range(count):
+        (dlen,) = _U8.unpack_from(mv, off)
+        off += _U8.size
+        dtype = np.dtype(bytes(mv[off:off + dlen]).decode("ascii"))
+        off += dlen
+        (ndim,) = _U8.unpack_from(mv, off)
+        off += _U8.size
+        shape = struct.unpack_from(f"!{ndim}Q", mv, off)
+        off += 8 * ndim
+        metas.append((dtype, shape))
+    out: List[Any] = []
+    for dtype, shape in metas:
+        nbytes = dtype.itemsize * int(np.prod(shape, dtype=np.int64))
+        arr = np.frombuffer(mv[off:off + nbytes], dtype=dtype).reshape(shape)
+        off += nbytes
+        out.append(arr.copy() if copy else arr)
+    return out
 
 
 def address(host: str, port: int) -> str:

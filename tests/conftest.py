@@ -91,6 +91,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "kvpool: fleet KV page-tier tests (rocket_tpu.serve.kvpool — "
+        "binary page codec, pool push/fetch/NACK, cross-process page "
+        "transfer, disaggregated prefill; see docs/performance.md "
+        "\"Fleet KV tier\"; spawn-heavy cases live in "
+        "tests/test_kvpool_proc.py on the heavy tail)",
+    )
+    config.addinivalue_line(
+        "markers",
         "warmstart: warm-start tier tests (rocket_tpu.tune "
         "compile_cache/warmup — persistent compile cache, AOT "
         "executable reuse, pre-warmed/standby spawns; see "
@@ -115,6 +123,7 @@ _HEAVY_TAIL = (
     "test_ladder_shapes.py",
     "test_mpmd.py",
     "test_procfleet.py",
+    "test_kvpool_proc.py",
 )
 
 

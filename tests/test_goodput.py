@@ -378,13 +378,27 @@ class TestMetricsExport:
 
         merged = merge_counters([
             {"serve/ok": 10.0, "serve/latency/p99": 0.5,
-             "serve/latency/p50": 0.1},
+             "serve/latency/p50": 0.1,
+             "serve_kvpool/fetches": 4.0,
+             "serve_kvpool/occupancy_bytes": 1024.0,
+             "serve_kvpool/capacity_bytes": 4096.0,
+             "serve_kvstore/occupancy_bytes": 100.0},
             {"serve/ok": 5.0, "serve/latency/p99": 0.9,
-             "serve/latency/p50": 0.05},
+             "serve/latency/p50": 0.05,
+             "serve_kvpool/fetches": 3.0,
+             "serve_kvpool/occupancy_bytes": 768.0,
+             "serve_kvpool/capacity_bytes": 4096.0,
+             "serve_kvstore/occupancy_bytes": 50.0},
         ])
         assert merged["serve/ok"] == 15.0           # counters SUM
         assert merged["serve/latency/p99"] == 0.9   # percentiles MAX
         assert merged["serve/latency/p50"] == 0.1
+        # the pool is a singleton: its gauges MAX, its counters still SUM
+        assert merged["serve_kvpool/fetches"] == 7.0
+        assert merged["serve_kvpool/occupancy_bytes"] == 1024.0
+        assert merged["serve_kvpool/capacity_bytes"] == 4096.0
+        # per-replica kvstore occupancies are distinct stores — SUM
+        assert merged["serve_kvstore/occupancy_bytes"] == 150.0
 
     def test_metrics_endpoint(self, clean_ledgers):
         from rocket_tpu.observe.export import MetricsServer
@@ -415,9 +429,13 @@ class TestMetricsExport:
         a = tmp_path / "replica0.json"
         b = tmp_path / "replica1.json"
         a.write_text(json.dumps(
-            {"serve/ok": 10.0, "serve/latency/p99": 0.5}))
+            {"serve/ok": 10.0, "serve/latency/p99": 0.5,
+             "serve_kvpool/bytes_moved": 2048.0,
+             "serve_kvpool/occupancy_bytes": 512.0}))
         b.write_text(json.dumps(
-            {"serve/ok": 5.0, "serve/latency/p99": 0.9}))
+            {"serve/ok": 5.0, "serve/latency/p99": 0.9,
+             "serve_kvpool/bytes_moved": 1024.0,
+             "serve_kvpool/occupancy_bytes": 640.0}))
         out = tmp_path / "fleet.json"
         assert _main([str(a), str(b), "--format", "json",
                       "-o", str(out)]) == 0
@@ -425,6 +443,8 @@ class TestMetricsExport:
             merged = json.load(f)
         assert merged["serve/ok"] == 15.0
         assert merged["serve/latency/p99"] == 0.9
+        assert merged["serve_kvpool/bytes_moved"] == 3072.0      # SUM
+        assert merged["serve_kvpool/occupancy_bytes"] == 640.0   # MAX
         # prom format to stdout parses too
         capsys.readouterr()  # drain the first call's "wrote ..." notice
         assert _main([str(a), str(b)]) == 0
