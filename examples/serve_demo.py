@@ -62,6 +62,18 @@ request trace so the two disciplines are directly comparable:
   migrated turn bit-equal to a cold in-process oracle.  ``--kv-bytes``
   sets the pool byte budget.  See docs/performance.md
   ("Fleet KV tier").
+- ``--mode train-serve`` — train-while-serve: a stand-in trainer
+  publishes verified weight versions (two-phase commit, checksummed,
+  mesh-stamped — :class:`rocket_tpu.persist.publish.WeightPublisher`)
+  while a real worker process serves, and a
+  :class:`rocket_tpu.serve.WeightFeed` hot-swaps each publication into
+  the live loop between decode rounds via donation (no second HBM
+  copy, zero recompiles).  One publication is torn live after its
+  commit marker lands; the deep verify gate rejects it without
+  touching serving, and a ``rollback()`` steps the fleet back one
+  published version.  Outputs verify bit-equal to an in-process
+  oracle on the same publication.  See docs/reliability.md
+  ("Live weight updates").
 - ``--trace`` (implies ``--mode robust``) — arm the structured tracer
   (:mod:`rocket_tpu.observe.trace`): every round/admit/request gets a
   span, the demo prints the p50/p95 queue-wait/TTFT/TPOT/e2e table at
@@ -1000,6 +1012,152 @@ def run_cache_fleet(args, model, draft, params, draft_params, arrivals,
                 accepted=0, drafted=0, new_tokens=tw.TOTAL - tw.P)
 
 
+def run_train_serve(args, model, draft, params, draft_params, arrivals,
+                    prompts):
+    """Train-while-serve: a stand-in trainer publishes verified weight
+    versions while ONE real worker process serves, and a
+    :class:`rocket_tpu.serve.WeightFeed` hot-swaps each publication into
+    the live loop between decode rounds — integrity-verified, reshard-
+    gated, donation-based (HBM never holds two copies of the params,
+    and the swap retraces nothing).  Publication #1 is torn live by
+    :class:`rocket_tpu.testing.chaos.TornPublishInjector` (a bit flip
+    AFTER its commit marker lands) and the deep verify gate rejects it
+    without touching serving; ``feed.rollback()`` then steps the fleet
+    back one published version.  Outputs are verified bit-equal to an
+    in-process oracle on the same publication.  See
+    docs/reliability.md ("Live weight updates")."""
+    from rocket_tpu.persist.publish import WeightPublisher
+    from rocket_tpu.serve import (
+        Completed, ProcReplica, Request, WeightFeed, WorkerSpec,
+        register_swap_source,
+    )
+    from rocket_tpu.testing import workers as tw
+    from rocket_tpu.testing.chaos import TornPublishInjector
+
+    root = tempfile.mkdtemp(prefix="rocket_tpu_publish_")
+    spec = WorkerSpec(builder="rocket_tpu.testing.workers:build_tiny_loop")
+    t = time.perf_counter()
+    rep = ProcReplica(spec, "ts0")
+    print(f"  [trainserve] spawned worker ts0 (pid {rep.pid}) in "
+          f"{time.perf_counter() - t:.1f}s; boot weights version "
+          f"{rep.weights_version} (seed-initialised, never published)")
+    feed = WeightFeed(root, [rep])
+    if args.metrics_port >= 0:
+        register_swap_source(feed)
+    print(f"  [trainserve] WeightFeed watching {root}")
+
+    # the "trainer": the real two-phase-commit publisher wrapped in the
+    # chaos injector — publication index 1 (version 20) gets one leaf
+    # bit-flipped AFTER its commit marker lands, the corruption shape
+    # shallow verification cannot see.  keep=3 retains the rollback
+    # target through the whole demo.
+    publisher = TornPublishInjector(
+        WeightPublisher(root, keep=3), tear_on={1: "garble"})
+
+    def publish(step, seed):
+        _, _, p, _ = tw.tiny_models(seed_target=seed)
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(-1), ("data",))
+        return publisher.publish({"params": p}, step=step, mesh=mesh)
+
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(1, tw.VOCAB, size=tw.P).astype(np.int32)
+    walls = []
+    seq = iter(range(1000))
+
+    def serve(tag):
+        t0 = time.perf_counter()
+        assert rep.submit(Request(rid=f"{tag}-{next(seq)}", prompt=prompt))
+        out = []
+        for _ in range(2000):
+            rep.pump()
+            out.extend(rep.drain_results())
+            if out:
+                break
+        walls.append((time.perf_counter() - t0) * 1e3)
+        (res,) = out
+        assert isinstance(res, Completed), res
+        return np.asarray(res.tokens)
+
+    t_run = time.perf_counter()
+    boot_tokens = serve("boot")
+
+    # -- step 10 publishes; the feed offers it; the worker swaps live --
+    publish(10, seed=5)
+    swaps = feed.poll()
+    v10_tokens = serve("v10")
+    print(f"  [trainserve] published step 10 -> feed swapped {swaps} "
+          f"replica(s); worker now serving version "
+          f"{rep.weights_version} "
+          f"(outputs changed: {not np.array_equal(boot_tokens, v10_tokens)})")
+    print(f"  [trainserve] swap wall so far: "
+          f"{rep.counters.get('swap_ms_total', 0.0):.1f} ms "
+          f"(charged to the 'swap' goodput bucket)")
+
+    # -- step 20 is torn in flight: rejected, serving untouched --------
+    publish(20, seed=9)
+    assert feed.poll() == 0
+    torn_tokens = serve("torn")
+    print(f"  [trainserve] published step 20 TORN (bit flip past the "
+          f"commit marker) -> deep verify rejected it: "
+          f"publish_rejected={int(rep.counters.get('publish_rejected', 0))},"
+          f" still serving version {rep.weights_version}, outputs "
+          f"untouched: {np.array_equal(torn_tokens, v10_tokens)}; "
+          f"a flight-recorder dump of the rejection was written "
+          f"worker-side; the feed will not re-offer it")
+
+    # -- step 30 supersedes the rejected version -----------------------
+    p30 = publish(30, seed=11)
+    feed.poll()
+    v30_tokens = serve("v30")
+    print(f"  [trainserve] published step 30 -> worker on version "
+          f"{rep.weights_version} "
+          f"({int(rep.counters.get('swaps', 0))} swaps, "
+          f"{int(rep.counters.get('publish_rejected', 0))} rejections)")
+
+    # -- divergence drill: bounded rollback to the previous version ----
+    feed.rollback()
+    rb_tokens = serve("rollback")
+    print(f"  [trainserve] rollback -> version {rep.weights_version}; "
+          f"outputs bit-equal to the version-10 serve: "
+          f"{np.array_equal(rb_tokens, v10_tokens)}")
+
+    # the swap is a delivery tier, never a correctness tier: an
+    # in-process loop swapped onto the SAME publication must agree
+    # bit-for-bit with the worker across the process boundary
+    oracle = tw.build_tiny_loop()
+    try:
+        oracle.swap_weights(p30, 30)
+        t0 = time.perf_counter()
+        oracle.submit(Request(rid="oracle", prompt=prompt))
+        (ro,) = oracle.run_until_idle()
+        walls.append((time.perf_counter() - t0) * 1e3)
+        bit_equal = np.array_equal(v30_tokens, np.asarray(ro.tokens))
+    finally:
+        oracle.close()
+    total = time.perf_counter() - t_run
+    print(f"  [trainserve] version-30 outputs bit-equal to in-process "
+          f"oracle on the same publication: {'yes' if bit_equal else 'NO'}")
+    snap = feed.snapshot()
+    print(f"  [trainserve] feed: {int(snap['polls'])} polls, "
+          f"{int(snap['pushes'])} pushes, {int(snap['swaps'])} swaps, "
+          f"{int(snap['rejected'])} rejected, "
+          f"{int(snap['rollbacks'])} rollbacks, "
+          f"version gauge {int(snap['version'])}")
+
+    n_swaps = int(rep.counters.get("swaps", 0))
+    rep.close()
+    feed.stop()
+    if args.metrics_port >= 0:
+        from rocket_tpu.observe.export import unregister_source
+
+        unregister_source("serve_swap")
+    shutil.rmtree(root, ignore_errors=True)
+    return dict(lat=np.asarray(walls), total=total, dispatches=n_swaps,
+                unit="live swaps", accepted=0, drafted=0,
+                new_tokens=tw.TOTAL - tw.P)
+
+
 def _report(name, res, n_requests):
     lat = res["lat"]
     new = res.get("new_tokens", NEW)
@@ -1026,7 +1184,7 @@ def main():
     parser.add_argument("--mode",
                         choices=("group", "continuous", "both", "robust",
                                  "fleet", "fleet-proc", "cache",
-                                 "cache-fleet"),
+                                 "cache-fleet", "train-serve"),
                         default="both")
     parser.add_argument("--autoscale", action="store_true",
                         help="[fleet-proc] start at ONE worker process "
@@ -1103,7 +1261,7 @@ def main():
     prompts = rng.integers(0, VOCAB, size=(args.requests, PROMPT))
     max_seq = (CACHE_PROMPT + NEW + NDRAFT if args.mode == "cache"
                else PROMPT + NEW + NDRAFT)
-    if args.mode in ("fleet-proc", "cache-fleet"):
+    if args.mode in ("fleet-proc", "cache-fleet", "train-serve"):
         # worker subprocesses build their own tiny models from a
         # WorkerSpec — nothing big to construct in this process
         model = draft = params = draft_params = None
@@ -1111,6 +1269,10 @@ def main():
         # the mode runs a scripted 5-request session trace (cold +
         # local 2-turn + migrated 2-turn); --requests is ignored
         args.requests = 5
+    elif args.mode == "train-serve":
+        # scripted publish/swap/reject/rollback trace (5 worker serves
+        # + 1 in-process oracle serve); --requests is ignored
+        args.requests = 6
     else:
         model, draft, params, draft_params = _build(max_seq=max_seq)
 
@@ -1129,7 +1291,8 @@ def main():
     runners = {"group": run_group, "continuous": run_continuous,
                "robust": run_robust, "fleet": run_fleet,
                "fleet-proc": run_fleet_proc, "cache": run_cache,
-               "cache-fleet": run_cache_fleet}
+               "cache-fleet": run_cache_fleet,
+               "train-serve": run_train_serve}
     modes = ["group", "continuous"] if args.mode == "both" else [args.mode]
     results = {}
     try:

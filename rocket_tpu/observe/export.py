@@ -104,6 +104,11 @@ _PERCENTILE_KEY = re.compile(r"/p\d+$")
 # The page pool is a singleton: its occupancy/capacity gauges appear in
 # every snapshot file but describe ONE store — MAX, never SUM.
 _POOL_GAUGE_KEY = re.compile(r"^serve_kvpool/.*(occupancy|capacity)_bytes$")
+# Weight-version gauges (train-while-serve): "which published version is
+# live" is a level, not a delta — summing two replicas both on version 7
+# would report 14.  Matches the WeightFeed's ``serve_swap/version`` and
+# any per-replica ``.../weights_version`` counter snapshot key.
+_VERSION_GAUGE_KEY = re.compile(r"^serve_swap/version$|(^|/)weights_version$")
 
 
 def merge_counters(snapshots: List[Dict[str, float]]) -> Dict[str, float]:
@@ -118,7 +123,8 @@ def merge_counters(snapshots: List[Dict[str, float]]) -> Dict[str, float]:
             except (TypeError, ValueError):
                 continue
             if key in out and (_PERCENTILE_KEY.search(key)
-                               or _POOL_GAUGE_KEY.match(key)):
+                               or _POOL_GAUGE_KEY.match(key)
+                               or _VERSION_GAUGE_KEY.search(key)):
                 out[key] = max(out[key], value)
             else:
                 out[key] = out.get(key, 0.0) + value

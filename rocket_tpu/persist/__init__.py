@@ -12,12 +12,15 @@ from rocket_tpu.persist.integrity import (
     verify,
 )
 from rocket_tpu.persist.orbax_io import CheckpointIO, default_io
+from rocket_tpu.persist.publish import WeightPublisher, latest_publication
 
 __all__ = [
     "Checkpointer",
     "CheckpointIO",
     "EmergencyTier",
     "TopologyMismatch",
+    "WeightPublisher",
+    "latest_publication",
     "default_io",
     "build_manifest",
     "check_reshard",

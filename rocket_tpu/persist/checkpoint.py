@@ -32,6 +32,7 @@ from rocket_tpu.core.capsule import Capsule
 from rocket_tpu.observe.ledger import get_goodput
 from rocket_tpu.persist import emergency, integrity
 from rocket_tpu.persist.orbax_io import default_io
+from rocket_tpu.persist.publish import WeightPublisher
 
 # Set by the SIGTERM handler; checked at every iteration boundary.  TPU pod
 # preemptions deliver SIGTERM with a grace window — the standard recovery
@@ -95,6 +96,9 @@ class Checkpointer(Capsule):
         save_on_preemption: bool = True,
         emergency_every: Optional[int] = None,
         emergency_dir_format: str = "emergency/{:06d}",
+        publish_every: Optional[int] = None,
+        publish_dir_format: str = "publish/{:06d}",
+        publish_keep: int = 2,
         track_metric: Optional[str] = None,
         keep_best: int = 1,
         best_mode: str = "max",
@@ -110,6 +114,10 @@ class Checkpointer(Capsule):
             raise ValueError(
                 "emergency_every must be >= 1 (or None to disable)"
             )
+        if publish_every is not None and publish_every < 1:
+            raise ValueError(
+                "publish_every must be >= 1 (or None to disable)"
+            )
         if best_mode not in ("max", "min"):
             raise ValueError(f"best_mode must be 'max'/'min', got {best_mode!r}")
         if keep_best < 1:
@@ -120,6 +128,12 @@ class Checkpointer(Capsule):
         )
         self._emergency_format = emergency_dir_format
         self._etier: Optional[emergency.EmergencyTier] = None
+        self._publish_every = (
+            int(publish_every) if publish_every is not None else None
+        )
+        self._publish_format = publish_dir_format
+        self._publish_keep = int(publish_keep)
+        self._publisher: Optional[WeightPublisher] = None
         self._format = output_dir_format
         self._keep_last = keep_last
         self._save_on_cycle_end = save_on_cycle_end
@@ -180,6 +194,13 @@ class Checkpointer(Capsule):
                     logger=self._logger,
                 )
             )
+        if self._publish_every is not None:
+            self._publisher = WeightPublisher(
+                self._runtime.project_dir,
+                dir_format=self._publish_format,
+                keep=self._publish_keep,
+                logger=self._logger,
+            )
         if (
             self._save_on_preemption
             and threading.current_thread() is threading.main_thread()
@@ -210,7 +231,8 @@ class Checkpointer(Capsule):
         root a snapshot was written under, or None on no match."""
         import re
 
-        for fmt in (self._format, self._best_format, self._emergency_format):
+        for fmt in (self._format, self._best_format, self._emergency_format,
+                    self._publish_format):
             parts = self._format_parts(fmt)
             if parts is None:
                 continue
@@ -301,6 +323,11 @@ class Checkpointer(Capsule):
                         or getattr(self._runtime, "rules", None)
                     ),
                 )
+        if (
+            self._publish_every is not None
+            and (self._iter_idx + 1) % self._publish_every == 0
+        ):
+            self.publish()
         self._iter_idx += 1
 
     def reset(self, attrs: Optional[Attributes] = None) -> None:
@@ -389,6 +416,37 @@ class Checkpointer(Capsule):
         default_io().save(path, items, force=True, manifest=manifest)
         self._logger.info("checkpoint -> %s", path)
         return path
+
+    def publish(self) -> Optional[str]:
+        """Publish the current state for live serving consumption —
+        a committed, mesh-stamped snapshot under ``publish/<step>`` the
+        serving fleet's :class:`~rocket_tpu.serve.feed.WeightFeed` polls
+        and hot-swaps from.  Returns the publication path (``None`` when
+        there is nothing stateful to publish).  Host-side cost charges
+        to the ``checkpoint`` goodput bucket; the serving-side swap cost
+        lands in ``swap`` on each replica."""
+        if self._publisher is None:
+            self._publisher = WeightPublisher(
+                self._runtime.project_dir,
+                dir_format=self._publish_format,
+                keep=self._publish_keep,
+                logger=self._logger,
+            )
+        items = self._collect_items()
+        if not items:
+            self._logger.warning("nothing to publish — no stateful state yet")
+            return None
+        with get_goodput().timed("checkpoint"):
+            return self._publisher.publish(
+                items,
+                step=self._iter_idx,
+                epoch_idx=self._epoch_idx,
+                mesh=self._runtime.mesh,
+                rules=(
+                    getattr(self._runtime, "partition_rules", None)
+                    or getattr(self._runtime, "rules", None)
+                ),
+            )
 
     def _collect_items(self) -> dict:
         """Every registered capsule's state, keyed by its registry key —

@@ -176,13 +176,23 @@ class CheckpointIO:
             if target is None:
                 composite_args[key] = ocp.args.StandardRestore()
             elif partial:
-                restore_args = jax.tree_util.tree_map(
-                    lambda leaf: ocp.ArrayRestoreArgs(
-                        sharding=getattr(leaf, "sharding", None),
+                # A target leaf WITHOUT a sharding (host numpy — the
+                # serving hot-swap restores to host first so the device
+                # swap can donate old buffers) restores as numpy;
+                # ArrayRestoreArgs(sharding=None) would refuse it.
+                def _rarg(leaf: Any) -> ocp.RestoreArgs:
+                    sharding = getattr(leaf, "sharding", None)
+                    if sharding is None:
+                        return ocp.RestoreArgs(
+                            restore_type=np.ndarray,
+                            dtype=getattr(leaf, "dtype", None),
+                        )
+                    return ocp.ArrayRestoreArgs(
+                        sharding=sharding,
                         dtype=getattr(leaf, "dtype", None),
-                    ),
-                    target,
-                )
+                    )
+
+                restore_args = jax.tree_util.tree_map(_rarg, target)
                 if _HAS_PARTIAL_RESTORE:
                     composite_args[key] = ocp.args.PyTreeRestore(
                         item=target,

@@ -1,0 +1,180 @@
+"""Weight publication — the trainer's half of train-while-serve.
+
+A continual-learning loop shares one model between an async trainer and
+a serving fleet: every ``publish_every`` steps the trainer *publishes*
+its current weights, and serving replicas hot-swap onto the newest valid
+publication between decode rounds.  This module owns the trainer side:
+
+- :class:`WeightPublisher` writes a publication under
+  ``<root>/publish/<step:06d>`` with exactly the emergency tier's
+  two-phase discipline: async device→host readback
+  (``copy_to_host_async`` per leaf, overlapped materialization), items
+  written first, mesh-stamped manifest + ``_COMMITTED`` marker last —
+  so a publication torn by a crash mid-write is simply *invisible* to
+  every consumer (no marker → ``integrity.verify`` fails → the feed
+  and the heal path both skip it);
+- :func:`latest_publication` elects the newest committed, valid
+  publication — the supervisor-side :class:`~rocket_tpu.serve.feed.
+  WeightFeed` polls it, and a healing worker's ``restore_params``
+  includes the publish subdir in its snapshot election so a respawn
+  lands on the newest *valid* version, never a torn one.
+
+The publication version is the training step recorded in the manifest
+(``iter_idx``): monotone, comparable across processes, and stamped into
+``serve_swap/version`` by every replica that applies it.
+
+Publications are weights-only (``{"params": ...}`` — or whatever item
+layout the caller hands over): :data:`PUBLISH_SUBDIR` is deliberately
+NOT in :data:`~rocket_tpu.persist.integrity.DEFAULT_SUBDIRS`, so a
+trainer ``resume("auto")`` never elects a params-only publication over
+a full TrainState snapshot.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+
+from rocket_tpu.persist import integrity
+from rocket_tpu.persist.emergency import _start_host_copies, _to_host
+from rocket_tpu.utils.logging import get_logger
+
+_logger = get_logger("publish")
+
+# The publish tier's subdir under the project root.  Kept OUT of
+# integrity.DEFAULT_SUBDIRS: only serving-side consumers (WeightFeed,
+# worker restore_params) add it to their election.
+PUBLISH_SUBDIR = "publish"
+
+
+class WeightPublisher:
+    """Atomic, committed weight publication for a live serving fleet.
+
+    Parameters
+    ----------
+    root:
+        Project directory publications land under.
+    dir_format:
+        Publication path format below ``root`` (digit-named so the
+        integrity scanner's election orders it by step).
+    keep:
+        Publications retained on disk.  Must be >= 2: a replica's
+        bounded rollback re-swaps onto the *previous* published
+        version, which must still exist when divergence is noticed.
+    """
+
+    def __init__(
+        self,
+        root: str,
+        dir_format: str = PUBLISH_SUBDIR + "/{:06d}",
+        keep: int = 2,
+        logger: Optional[Any] = None,
+    ) -> None:
+        if keep < 2:
+            raise ValueError(
+                "keep must be >= 2 (rollback needs the previous version)")
+        self._root = os.path.abspath(root)
+        self._format = dir_format
+        self._keep = int(keep)
+        self._logger = logger if logger is not None else _logger
+        self.publishes = 0
+
+    def publish(
+        self,
+        items: Dict[str, Any],
+        *,
+        step: int,
+        epoch_idx: Optional[int] = None,
+        mesh: Any = None,
+        rules: Any = None,
+    ) -> str:
+        """Write ``items`` as the committed publication for ``step`` and
+        return its path.  Cheap by the emergency tier's recipe: the
+        device→host copies are started async across all leaves before
+        any leaf materializes, so the transfers overlap each other; the
+        write itself is synchronous (a publication must be durable
+        before the feed can announce it) but runs on whatever thread
+        the trainer calls this from."""
+        for tree in items.values():
+            _start_host_copies(tree)
+        host_items = {key: _to_host(tree) for key, tree in items.items()}
+        path = os.path.join(self._root, self._format.format(int(step)))
+        self._write(path, host_items, int(step), epoch_idx, mesh, rules)
+        self.publishes += 1
+        self._logger.info("published weights (step %d) -> %s", step, path)
+        self._prune(keep_path=path)
+        return path
+
+    def _write(
+        self,
+        path: str,
+        items: Dict[str, Any],
+        step: int,
+        epoch_idx: Optional[int],
+        mesh: Any,
+        rules: Any,
+    ) -> None:
+        import orbax.checkpoint as ocp
+
+        from rocket_tpu.persist.orbax_io import _to_saveable
+
+        # Transient sync checkpointer, same reasoning as the emergency
+        # flush: the shared async CheckpointIO must not have its item
+        # keys rebound, and the two-phase commit below requires the
+        # items durable BEFORE the marker lands.
+        with ocp.Checkpointer(ocp.CompositeCheckpointHandler()) as ckptr:
+            ckptr.save(
+                path,
+                args=ocp.args.Composite(
+                    **{
+                        key: ocp.args.StandardSave(_to_saveable(tree))
+                        for key, tree in items.items()
+                    }
+                ),
+                force=True,
+            )
+        manifest = integrity.build_manifest(
+            items, iter_idx=step, epoch_idx=epoch_idx,
+            checksums=True, mesh=mesh, rules=rules,
+        )
+        if jax.process_index() == 0:
+            integrity.write_manifest(path, manifest)
+            integrity.write_commit_marker(path)
+
+    def _prune(self, keep_path: str) -> None:
+        if jax.process_index() != 0:
+            return
+        parent = os.path.dirname(keep_path)
+        dirs = integrity._snapshot_dirs(
+            os.path.dirname(parent), os.path.basename(parent)
+        )  # newest first
+        for _, victim in dirs[self._keep:]:
+            if os.path.abspath(victim) != os.path.abspath(keep_path):
+                shutil.rmtree(victim, ignore_errors=True)
+
+
+def latest_publication(
+    root: str, deep: bool = False
+) -> Optional[Tuple[int, str]]:
+    """``(version, path)`` of the newest committed, valid publication
+    under ``root`` — or ``None`` when nothing publishable exists.
+
+    The version is the manifest's recorded training step (falling back
+    to the directory index).  Broken publications are *skipped*, never
+    quarantined: the trainer may still be mid-write on a newer dir, and
+    quarantine is the restore path's job, not the poll path's."""
+    path = integrity.latest_valid(
+        os.path.abspath(root), subdirs=(PUBLISH_SUBDIR,), deep=deep,
+        do_quarantine=False,
+    )
+    if path is None:
+        return None
+    manifest = integrity.read_manifest(path) or {}
+    version = manifest.get("iter_idx")
+    if not isinstance(version, int):
+        name = os.path.basename(path)
+        version = int(name) if name.isdigit() else -1
+    return int(version), path

@@ -12,6 +12,7 @@ from rocket_tpu.serve.autoscale import (
     register_fleet_source,
     successive_halving_capacity,
 )
+from rocket_tpu.serve.feed import WeightFeed, register_swap_source
 from rocket_tpu.serve.fleet import PrefillReplica, Replica
 from rocket_tpu.serve.kvpool import (
     KVPagePool,
@@ -82,10 +83,12 @@ __all__ = [
     "ServeLatency",
     "ServingLoop",
     "SharedPrefixIndex",
+    "WeightFeed",
     "WorkerSpec",
     "page_hashes",
     "register_fleet_source",
     "register_kvpool_source",
     "register_kvstore_source",
+    "register_swap_source",
     "successive_halving_capacity",
 ]

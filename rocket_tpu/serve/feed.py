@@ -1,0 +1,193 @@
+"""WeightFeed — the supervisor half of train-while-serve.
+
+A trainer armed with ``Checkpointer(publish_every=N)`` drops committed
+publications under ``<root>/publish/`` (two-phase: items first, manifest
++ ``_COMMITTED`` last — see :mod:`rocket_tpu.persist.publish`).  The
+feed is the bridge from that directory to the serving fleet: each
+:meth:`poll` elects the newest VALID publication (torn saves are
+invisible by construction) and pushes a ``NEW_WEIGHTS`` notification to
+every replica not already on it.  Process-backed replicas receive the
+push over :mod:`rocket_tpu.serve.wire`; in-process replicas take the
+same call directly.
+
+The push is an OFFER, not a command: the worker re-verifies (deep, by
+default — checksums every leaf) and runs the ``check_reshard`` gate
+against its own mesh before swapping, so a publication that tore or
+garbled AFTER election, or that no longer fits the server topology, is
+rejected worker-side — the feed remembers the rejection and stops
+re-offering that path (``publish_rejected`` keeps counting worker-side
+either way; re-offering a known-bad version every beat would just
+re-dump the flight recorder).
+
+Polling is deliberate: a deterministic tick the caller (or the optional
+daemon thread) drives, not an inotify watcher — chaos tests schedule
+tears against exact poll indices, and the supervision beat already has
+a natural cadence to hang this on.
+
+:func:`register_swap_source` exports the feed's decisions as a
+``serve_swap/*`` metrics source (`docs/observability.md`): swap /
+reject / rollback counters merge by SUM across hosts, the ``version``
+gauge by MAX.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, Optional, Sequence
+
+from rocket_tpu.persist.publish import latest_publication
+
+LOG = logging.getLogger("rocket_tpu.serve.fleet")
+
+
+class WeightFeed:
+    """Watch a publish root; push the newest valid publication fleet-ward.
+
+    ``replicas`` is any sequence of objects with ``swap_weights(path,
+    version) -> bool`` and a ``weights_version`` property — both
+    :class:`~rocket_tpu.serve.fleet.Replica` and
+    :class:`~rocket_tpu.serve.procfleet.ProcReplica` qualify; a live
+    router's ``.replicas`` list works as-is and picks up autoscaler
+    joins automatically because the feed re-reads it every poll.
+
+    ``deep_verify`` is forwarded to the workers' swap gate (default
+    True: a full per-leaf checksum re-read is the only defense against
+    a publication garbled on disk after commit)."""
+
+    def __init__(self, root: str, replicas: Sequence[Any], *,
+                 deep_verify: bool = True,
+                 logger: Optional[logging.Logger] = None) -> None:
+        self._root = os.path.abspath(root)
+        self._replicas = replicas
+        self._deep_verify = bool(deep_verify)
+        self._log = logger if logger is not None else LOG
+        # path -> version of pushes some worker REJECTED: never re-offer
+        self._rejected: Dict[str, int] = {}
+        self.polls = 0
+        self.pushes = 0          # NEW_WEIGHTS offers sent
+        self.swaps = 0           # offers the worker applied
+        self.rejects = 0         # offers the worker refused
+        self.rollbacks = 0       # rollback orders sent AND applied
+        self.version = -1        # newest version any replica runs (gauge)
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+
+    # -- one deterministic beat ----------------------------------------
+
+    def poll(self) -> int:
+        """One feed beat: elect the newest valid publication, offer it
+        to every replica not already on it.  Returns the number of
+        successful swaps this beat (0 = fleet already current, nothing
+        published yet, or every offer was rejected)."""
+        self.polls += 1
+        latest = latest_publication(self._root)
+        if latest is None:
+            return 0
+        version, path = latest
+        if self._rejected.get(path) == version:
+            return 0
+        swapped = 0
+        for replica in list(self._replicas):
+            current = int(getattr(replica, "weights_version", -1))
+            if current >= version:
+                continue
+            self.pushes += 1
+            try:
+                ok = replica.swap_weights(path, version,
+                                          deep_verify=self._deep_verify)
+            except TypeError:
+                # a replica surface without the keyword (older builds)
+                ok = replica.swap_weights(path, version)
+            if ok:
+                swapped += 1
+                self.swaps += 1
+                self.version = max(self.version, version)
+            else:
+                self.rejects += 1
+                self._rejected[path] = version
+                self._log.warning(
+                    "feed: replica %s rejected publication %s "
+                    "(version %d) — not re-offering",
+                    getattr(replica, "replica_id", "?"), path, version)
+        return swapped
+
+    def rollback(self) -> int:
+        """Order every replica one bounded step back to its previous
+        published version (the divergence remedy — see
+        docs/reliability.md).  Returns how many replicas rolled back."""
+        rolled = 0
+        for replica in list(self._replicas):
+            try:
+                ok = replica.rollback_weights()
+            except Exception as exc:
+                self._log.warning("feed: rollback on replica %s failed: "
+                                  "%r", getattr(replica, "replica_id", "?"),
+                                  exc)
+                ok = False
+            if ok:
+                rolled += 1
+                self.rollbacks += 1
+        # the rolled-back version is whatever the replicas now report
+        versions = [int(getattr(r, "weights_version", -1))
+                    for r in list(self._replicas)]
+        self.version = max(versions) if versions else -1
+        return rolled
+
+    # -- optional daemon -----------------------------------------------
+
+    def start(self, interval_s: float = 1.0) -> None:
+        """Poll on a daemon thread — production convenience; tests and
+        the supervision beat call :meth:`poll` directly."""
+        if self._thread is not None:
+            return
+        stop = threading.Event()
+
+        def beat() -> None:
+            while not stop.is_set():
+                try:
+                    self.poll()
+                except Exception:
+                    self._log.warning("feed: poll failed", exc_info=True)
+                stop.wait(interval_s)
+
+        self._stop = stop
+        self._thread = threading.Thread(target=beat, name="weight-feed",
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._thread = None
+        self._stop = None
+
+    close = stop
+
+    # -- observability --------------------------------------------------
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat float dict for the metrics exporter: counters SUM across
+        hosts, ``version`` MAX (see ``observe.export.merge_counters``)."""
+        return {
+            "polls": float(self.polls),
+            "pushes": float(self.pushes),
+            "swaps": float(self.swaps),
+            "rejected": float(self.rejects),
+            "rollbacks": float(self.rollbacks),
+            "version": float(self.version),
+        }
+
+
+def register_swap_source(feed: WeightFeed,
+                         name: str = "serve_swap") -> str:
+    """Register the feed's snapshot as an ``observe.export`` source so
+    ``/metrics`` serves ``rocket_tpu_serve_swap_*`` series.  Returns the
+    source name."""
+    from rocket_tpu.observe.export import register_source
+
+    register_source(name, feed.snapshot)
+    return name

@@ -195,6 +195,36 @@ class Replica:
             except Exception as exc:
                 self._dead = f"drain failed: {exc!r}"
 
+    # -- live weight hot-swap ------------------------------------------
+
+    def swap_weights(self, path: str, version: Optional[int] = None, *,
+                     deep_verify: bool = True) -> bool:
+        """Hot-swap this replica's loop onto a committed publication.
+
+        Between-rounds discipline is the CALLER's here: drive rounds
+        synchronously (router pump) around the swap, or ``stop()`` the
+        driver thread first.  Process-backed replicas get it
+        structurally from the one-in-flight RPC socket."""
+        if self._dead is not None:
+            return False
+        with self._lock:
+            return bool(self.loop.swap_weights(
+                path, version, deep_verify=deep_verify))
+
+    def rollback_weights(self) -> bool:
+        """Bounded rollback onto the previously applied published
+        version (see :meth:`ServingLoop.rollback_weights`)."""
+        if self._dead is not None:
+            return False
+        with self._lock:
+            return bool(self.loop.rollback_weights())
+
+    @property
+    def weights_version(self) -> int:
+        if self._dead is not None:
+            return -1
+        return int(getattr(self.loop, "weights_version", -1))
+
     # -- self-healing --------------------------------------------------
 
     def heal(self) -> Tuple[List[Any], List[Request]]:

@@ -41,6 +41,7 @@ single-threaded and re-entrant only via :meth:`run_round`.
 from __future__ import annotations
 
 import logging
+import os
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -218,6 +219,16 @@ class ServingLoop:
                              "local store before admission imports them)")
         self.kvpool = kvpool
 
+        # Train-while-serve (ISSUE 17): the newest published weights this
+        # loop has applied.  ``_live_params`` survives watchdog rebuilds
+        # (the factory would otherwise revert a rebuilt batcher to its
+        # closure's original — possibly donated-away — weights);
+        # ``_prev_weights`` anchors the bounded rollback.
+        self._live_params: Optional[Any] = None
+        self._weights_version: int = -1
+        self._weights_path: Optional[str] = None
+        self._prev_weights: Optional[Tuple[int, str]] = None
+
         self._bat = self._build_batcher()
         self.base_n_draft = int(self._bat.n_draft)
         if self.kvstore is not None and not self._bat.prefix_cache_ok:
@@ -234,6 +245,10 @@ class ServingLoop:
         bat = self._factory()
         if self._kv_cache_int8 is not None:
             bat.set_kv_cache_int8(self._kv_cache_int8)
+        if self._live_params is not None:
+            # A rebuild after a hot-swap must serve the SWAPPED weights:
+            # the factory closure's originals may already be donated away.
+            bat._params = self._live_params
         return bat
 
     def _warm_start(self, bat: Any) -> None:
@@ -393,6 +408,175 @@ class ServingLoop:
         """Return and clear all typed results produced so far."""
         out, self._results = self._results, []
         return out
+
+    # -- live weight hot-swap (train-while-serve) ----------------------
+
+    @property
+    def weights_version(self) -> int:
+        """Newest applied published version (-1 = factory weights)."""
+        return self._weights_version
+
+    def swap_weights(self, path: str, version: Optional[int] = None, *,
+                     deep_verify: bool = True) -> bool:
+        """Hot-swap the target params onto a committed publication at
+        ``path`` — called BETWEEN decode rounds only (the worker's
+        one-in-flight RPC discipline makes that structural; an
+        in-process caller must not call this from inside
+        :meth:`run_round`).
+
+        The gate sequence is verify → locate → ``check_reshard`` →
+        restore-to-host → donation swap: the publication is integrity-
+        verified (``deep_verify`` re-checksums every leaf, which is what
+        catches a garbled-on-disk publication the commit marker cannot),
+        its manifest locates the params subtree (a trainer publishes its
+        whole TrainState; only the params restore), the reshard gate
+        validates every leaf against THIS loop's mesh placement, and the
+        device swap is per-leaf delete-then-put — the old leaf's buffer
+        is freed before the new one uploads, so HBM never holds two full
+        copies of the model.  The batcher's params are a jit *argument*
+        (same shapes/dtypes/shardings), so the swap costs zero retrace.
+
+        In-flight rows keep their KV pages and simply continue — their
+        remaining tokens decode under the new weights from the next
+        round boundary on; requests admitted after the swap are
+        end-to-end bit-equal to a server freshly loaded from the same
+        publication.  Any failure rejects the publication: counter +
+        flight dump, serving continues on the old weights untouched.
+
+        Wall time charges to the ``swap`` goodput bucket and the
+        ``swap_ms_total`` counter."""
+        t0 = time.monotonic()
+        with get_goodput().timed("swap"):
+            ok = self._swap_inner(path, version, deep_verify,
+                                  rollback=False)
+        self.counters.swap_ms_total += (time.monotonic() - t0) * 1e3
+        return ok
+
+    def rollback_weights(self) -> bool:
+        """Bounded rollback: re-swap onto the PREVIOUS applied published
+        version (the divergence remedy).  One step deep by design — the
+        publisher retains ``keep >= 2`` publications, so the previous
+        path still exists when divergence is noticed.  ``False`` when
+        no previous published version exists."""
+        prev = self._prev_weights
+        if prev is None:
+            self._log.warning(
+                "serve: rollback requested but no previous published "
+                "version is known")
+            return False
+        version, path = prev
+        t0 = time.monotonic()
+        with get_goodput().timed("swap"):
+            ok = self._swap_inner(path, version, deep_verify=True,
+                                  rollback=True)
+        self.counters.swap_ms_total += (time.monotonic() - t0) * 1e3
+        return ok
+
+    def _swap_inner(self, path: str, version: Optional[int],
+                    deep_verify: bool, rollback: bool) -> bool:
+        import jax
+
+        from rocket_tpu.persist import integrity
+        from rocket_tpu.persist.orbax_io import CheckpointIO
+        from rocket_tpu.serve.worker import _locate_params
+
+        path = os.path.abspath(path)
+        ok, reason = integrity.verify(path, deep=deep_verify)
+        if not ok:
+            return self._reject_publish(path, reason)
+        manifest = integrity.read_manifest(path)
+        if version is None:
+            v = (manifest or {}).get("iter_idx")
+            version = int(v) if isinstance(v, int) else -1
+        item_key, prefix = _locate_params(manifest)
+        old = self._bat._params
+        nested: Any = old
+        for part in reversed(prefix):
+            nested = {part: nested}
+        try:
+            integrity.check_reshard(manifest, {item_key: nested})
+        except integrity.TopologyMismatch as exc:
+            return self._reject_publish(path, f"topology: {exc}")
+        # Restore to HOST numpy first: the publication lands in host RAM
+        # only, so the device-side swap below can free each old leaf
+        # before uploading its replacement.
+        host_nested = jax.tree_util.tree_map(
+            lambda x: np.empty(tuple(getattr(x, "shape", ())),
+                               getattr(x, "dtype", np.float32)),
+            nested,
+        )
+        io = CheckpointIO(use_async=False)
+        try:
+            out = io.restore_item(path, item_key, target=host_nested,
+                                  partial=bool(prefix))
+        except Exception as exc:
+            return self._reject_publish(path, f"restore failed: {exc!r}")
+        finally:
+            io.close()
+        for part in prefix:
+            out = out[part]
+        with self._tracer.span("serve/swap", path=path, version=version,
+                               rollback=rollback):
+            new_params = self._donation_swap(old, out)
+        self._bat._params = new_params
+        self._live_params = new_params
+        if rollback:
+            self.counters.swap_rollbacks += 1
+            self._prev_weights = None
+        else:
+            if self._weights_path is not None:
+                self._prev_weights = (self._weights_version,
+                                      self._weights_path)
+            self.counters.swaps += 1
+        self._weights_version = int(version)
+        self._weights_path = path
+        self.counters.weights_version = int(version)
+        self._log.info(
+            "serve: weights %s -> version %d (%s)",
+            "rolled back" if rollback else "hot-swapped", version, path)
+        return True
+
+    @staticmethod
+    def _donation_swap(old_tree: Any, new_host_tree: Any) -> Any:
+        """Per-leaf donation: free the old device buffer, THEN upload
+        the replacement onto the same sharding — peak device residency
+        is one model plus one leaf, never two models."""
+        import jax
+
+        def leaf(old: Any, new: Any) -> Any:
+            sharding = getattr(old, "sharding", None)
+            dtype = getattr(old, "dtype", None)
+            # The replacement must present the IDENTICAL jit signature —
+            # dtype, sharding, AND commitment: device_put(x, sharding)
+            # commits, but seed-initialised params are uncommitted, and
+            # a committed/uncommitted flip alone retraces the round.
+            committed = bool(getattr(old, "committed", False))
+            new = np.asarray(new)
+            if dtype is not None and new.dtype != dtype:
+                new = new.astype(dtype)
+            if hasattr(old, "delete"):
+                try:
+                    old.delete()
+                except Exception:
+                    pass  # already donated / deleted elsewhere
+            if sharding is not None and committed:
+                return jax.device_put(new, sharding)
+            return jax.device_put(new)
+
+        return jax.tree_util.tree_map(leaf, old_tree, new_host_tree)
+
+    def _reject_publish(self, path: str, reason: str) -> bool:
+        """A publication that fails any gate is REJECTED, never
+        half-applied: count it, dump the flight recorder for the
+        post-mortem, keep serving the current weights."""
+        self.counters.publish_rejected += 1
+        self._tracer.instant("serve/publish_rejected", path=path,
+                             reason=str(reason)[:200])
+        dump = self._dump_flight("publish-rejected")
+        self._log.warning(
+            "serve: publication %s rejected (%s)%s", path, reason,
+            f" — flight dump {dump}" if dump else "")
+        return False
 
     # -- the round -----------------------------------------------------
 

@@ -30,6 +30,11 @@ class ServeCounters:
         self.pool_nacks = 0         # pool consulted, nothing usable (stale)
         self.pool_pushed_pages = 0  # pages this loop pushed pool-ward
         self.completed = 0
+        self.swaps = 0              # live weight hot-swaps applied
+        self.publish_rejected = 0   # publications refused by verify/reshard
+        self.swap_rollbacks = 0     # bounded rollbacks to the prior version
+        self.weights_version = -1   # gauge: newest applied published version
+        self.swap_ms_total = 0.0    # wall time spent inside swaps (counter)
         self.shed_overload = 0      # bounded-queue / draining rejections
         self.shed_deadline = 0      # shed before prefill (stage='queue')
         self.evicted_deadline = 0   # evicted mid-decode (stage='decode')
@@ -67,6 +72,11 @@ class ServeCounters:
             "pool_nacks": float(self.pool_nacks),
             "pool_pushed_pages": float(self.pool_pushed_pages),
             "completed": float(self.completed),
+            "swaps": float(self.swaps),
+            "publish_rejected": float(self.publish_rejected),
+            "swap_rollbacks": float(self.swap_rollbacks),
+            "weights_version": float(self.weights_version),
+            "swap_ms_total": float(self.swap_ms_total),
             "shed_overload": float(self.shed_overload),
             "shed_deadline": float(self.shed_deadline),
             "evicted_deadline": float(self.evicted_deadline),

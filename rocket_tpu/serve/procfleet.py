@@ -132,7 +132,7 @@ class ProcReplica:
             self._fs = listener.accept(timeout=self._spawn_timeout)
         finally:
             listener.close()
-        wire.send_msg(self._fs, wire.HELLO, self._spec)
+        wire.send_msg(self._fs, wire.HELLO, wire.hello_payload(self._spec))
         kind, payload = wire.recv_msg(self._fs, self._spawn_timeout)
         if kind == wire.ERROR:
             raise RuntimeError(
@@ -141,6 +141,10 @@ class ProcReplica:
         if kind != wire.READY:
             raise RuntimeError(
                 f"replica {self.replica_id}: expected READY, got {kind!r}")
+        # Versioned handshake: a worker from a different build announces
+        # a different ``proto`` in READY and is rejected HERE with the
+        # remedy, before any request frame risks un-pickling garbage.
+        payload = wire.check_ready(payload)
         self.spawns += 1
         self._load = 0
         self._health = HealthState.SERVING
@@ -306,6 +310,37 @@ class ProcReplica:
     def drain(self) -> None:
         """Stop the worker admitting new requests (autoscaler retire)."""
         self._rpc(wire.DRAIN)
+
+    def swap_weights(self, path: str, version: Optional[int] = None, *,
+                     deep_verify: bool = True) -> bool:
+        """One NEW_WEIGHTS RPC: the worker verifies + hot-swaps between
+        decode rounds (structurally — this frame cannot overlap a STEP).
+        ``False`` on rejection OR replica death; a rejection leaves the
+        worker serving its current weights untouched."""
+        reply = self._rpc(wire.NEW_WEIGHTS, {
+            "path": path, "version": version, "deep_verify": deep_verify,
+        })
+        if reply is None:
+            return False
+        with self._lock:
+            self.counters = reply.get("counters", self.counters)
+        return bool(reply.get("swapped"))
+
+    def rollback_weights(self) -> bool:
+        """One ROLLBACK_WEIGHTS RPC: bounded rollback onto the worker's
+        previously applied published version."""
+        reply = self._rpc(wire.ROLLBACK_WEIGHTS)
+        if reply is None:
+            return False
+        with self._lock:
+            self.counters = reply.get("counters", self.counters)
+        return bool(reply.get("swapped"))
+
+    @property
+    def weights_version(self) -> int:
+        """Newest published version the worker reported applying (-1
+        until the first swap's counters land supervisor-side)."""
+        return int(self.counters.get("weights_version", -1.0))
 
     def collect(self) -> Optional[Dict[str, Any]]:
         """One COLLECT RPC: counters + latency plus the worker's retrace

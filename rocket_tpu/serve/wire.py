@@ -32,9 +32,37 @@ import numpy as np
 from rocket_tpu.serve.types import Request
 from rocket_tpu.utils.framing import FramedSocket
 
+# -- protocol version --------------------------------------------------------
+
+# Bumped whenever a frame's pickled layout changes incompatibly.  The
+# version crosses in BOTH handshake directions — the HELLO payload and
+# the READY reply each carry ``proto`` — so a supervisor and a worker
+# from different builds reject each other with a typed
+# :class:`ProtocolMismatch` naming the remedy, instead of un-pickling
+# garbage three RPCs into the run.
+#   1: versioned handshake; NEW_WEIGHTS / ROLLBACK_WEIGHTS swap RPCs.
+PROTOCOL_VERSION = 1
+
+
+class ProtocolMismatch(RuntimeError):
+    """Supervisor and worker speak different wire-protocol versions."""
+
+    def __init__(self, ours: int, theirs: Any, side: str) -> None:
+        super().__init__(
+            f"wire protocol mismatch: this {side} speaks version {ours}, "
+            f"peer announced {theirs!r}. Remedy: supervisor and worker "
+            f"must run the same rocket_tpu build — update the worker "
+            f"environment (or the supervisor's) so both import the same "
+            f"rocket_tpu.serve.wire.PROTOCOL_VERSION, then respawn."
+        )
+        self.ours = int(ours)
+        self.theirs = theirs
+        self.side = side
+
+
 # -- message kinds -----------------------------------------------------------
 
-HELLO = "hello"          # supervisor -> worker: the WorkerSpec
+HELLO = "hello"          # supervisor -> worker: {"proto", "spec"}
 READY = "ready"          # worker -> supervisor: loop built, serving
 SUBMIT = "submit"        # packed request -> {"accepted": bool, "load": int}
 STEP = "step"            # run one round -> results/busy/load/health/...
@@ -49,6 +77,15 @@ RENAME = "rename"        # re-stamp the worker's fleet identity (a warm
                          # results under the adopting replica's id)
 REPLY = "reply"          # generic success reply
 ERROR = "error"          # worker -> supervisor: payload is the repr
+
+# Train-while-serve (serve/feed.py).  NEW_WEIGHTS announces a committed
+# publication ({"path", "version"}); the worker verifies + hot-swaps
+# BETWEEN decode rounds (the one-in-flight RPC discipline makes that
+# structural: a swap RPC can never overlap a STEP round) and replies
+# with the outcome.  ROLLBACK_WEIGHTS re-swaps onto the previously
+# applied published version (bounded rollback after divergence).
+NEW_WEIGHTS = "new_weights"
+ROLLBACK_WEIGHTS = "rollback_weights"
 
 # Fleet KV page tier (serve/kvpool.py).  These cross between a replica's
 # KVPoolClient and the supervisor-hosted KVPagePool, NOT on the
@@ -113,6 +150,45 @@ class WorkerSpec:
         if self.restore_dir is not None:
             kwargs["restore_dir"] = self.restore_dir
         return self.resolve()(**kwargs)
+
+
+# -- handshake ---------------------------------------------------------------
+
+
+def hello_payload(spec: "WorkerSpec") -> Dict[str, Any]:
+    """The HELLO frame's payload: the WorkerSpec wrapped with this
+    build's protocol version."""
+    return {"proto": PROTOCOL_VERSION, "spec": spec}
+
+
+def check_hello(payload: Any) -> "WorkerSpec":
+    """Worker-side HELLO validation: returns the spec, or raises a typed
+    :class:`ProtocolMismatch` when the supervisor announced a different
+    version (a bare WorkerSpec — the pre-versioning frame — counts as
+    version 0)."""
+    if isinstance(payload, WorkerSpec):
+        raise ProtocolMismatch(PROTOCOL_VERSION, 0, side="worker")
+    if not isinstance(payload, dict):
+        raise ProtocolMismatch(PROTOCOL_VERSION, None, side="worker")
+    proto = payload.get("proto")
+    if proto != PROTOCOL_VERSION:
+        raise ProtocolMismatch(PROTOCOL_VERSION, proto, side="worker")
+    spec = payload.get("spec")
+    if not isinstance(spec, WorkerSpec):
+        raise ValueError(
+            f"HELLO payload carries no WorkerSpec (got {type(spec)!r})")
+    return spec
+
+
+def check_ready(payload: Any) -> Dict[str, Any]:
+    """Supervisor-side READY validation: returns the payload dict, or
+    raises :class:`ProtocolMismatch` when the worker announced a
+    different version (a READY without ``proto`` counts as version 0)."""
+    info = dict(payload or {})
+    proto = info.get("proto", 0)
+    if proto != PROTOCOL_VERSION:
+        raise ProtocolMismatch(PROTOCOL_VERSION, proto, side="supervisor")
+    return info
 
 
 # -- request / result packing ------------------------------------------------

@@ -78,8 +78,16 @@ def restore_params(restore_dir: str, targets: Any) -> Any:
     instead of serving mis-placed weights."""
     from rocket_tpu.persist import integrity
     from rocket_tpu.persist.orbax_io import CheckpointIO
+    from rocket_tpu.persist.publish import PUBLISH_SUBDIR
 
-    path = integrity.latest_valid(restore_dir, do_quarantine=False)
+    # Workers ALSO elect the publish tier (train-while-serve): a worker
+    # respawned mid-run must come back on the newest published weights,
+    # not the weights from before the run started.  The trainer's own
+    # resume deliberately ignores this subdir — a params-only
+    # publication cannot resume optimizer state.
+    subdirs = tuple(integrity.DEFAULT_SUBDIRS) + (PUBLISH_SUBDIR,)
+    path = integrity.latest_valid(restore_dir, subdirs=subdirs,
+                                  do_quarantine=False)
     if path is None:
         path = integrity.resolve_restore_path(restore_dir,
                                               do_quarantine=False)
@@ -160,6 +168,26 @@ def serve(fs: FramedSocket, loop: Any, *,
                 loop.replica_id = payload
                 loop.queue.name = payload
                 wire.send_msg(fs, wire.REPLY, {"replica_id": payload})
+            elif kind == wire.NEW_WEIGHTS:
+                # Hot-swap happens HERE — between decode rounds by
+                # construction: STEP RPCs are the only way rounds run,
+                # and the supervisor's one-in-flight discipline means
+                # this frame can never overlap one.
+                ok = loop.swap_weights(
+                    payload["path"], payload.get("version"),
+                    deep_verify=bool(payload.get("deep_verify", True)))
+                wire.send_msg(fs, wire.REPLY, {
+                    "swapped": bool(ok),
+                    "version": int(getattr(loop, "weights_version", -1)),
+                    "counters": loop.counters.snapshot(),
+                })
+            elif kind == wire.ROLLBACK_WEIGHTS:
+                ok = loop.rollback_weights()
+                wire.send_msg(fs, wire.REPLY, {
+                    "swapped": bool(ok),
+                    "version": int(getattr(loop, "weights_version", -1)),
+                    "counters": loop.counters.snapshot(),
+                })
             elif kind == wire.COLLECT:
                 from rocket_tpu.observe.ledger import (get_goodput,
                                                        get_retrace_ledger)
@@ -201,10 +229,17 @@ def main(argv: Optional[list] = None) -> int:
     host, port = parse_address(args.connect)
     fs = FramedSocket.connect(host, port)
     try:
-        kind, spec = wire.recv_msg(fs, _HELLO_TIMEOUT_S)
-        if kind != wire.HELLO or not isinstance(spec, wire.WorkerSpec):
+        kind, payload = wire.recv_msg(fs, _HELLO_TIMEOUT_S)
+        if kind != wire.HELLO:
             wire.send_msg(fs, wire.ERROR,
-                          f"expected HELLO WorkerSpec, got {kind!r}")
+                          f"expected HELLO, got {kind!r}")
+            return 2
+        try:
+            spec = wire.check_hello(payload)
+        except (wire.ProtocolMismatch, ValueError) as exc:
+            # The typed refusal travels back as the ERROR payload, so
+            # the supervisor's spawn failure names the remedy.
+            wire.send_msg(fs, wire.ERROR, str(exc))
             return 2
         # Warm-start tier (ISSUE 15): arm the persistent compile cache
         # and the ledgers BEFORE the build, so every compile the build
@@ -245,6 +280,7 @@ def main(argv: Optional[list] = None) -> int:
         import jax
 
         wire.send_msg(fs, wire.READY, {
+            "proto": wire.PROTOCOL_VERSION,
             "pid": os.getpid(),
             "devices": int(jax.local_device_count()),
             "platform": jax.default_backend(),

@@ -30,7 +30,16 @@ Three fault families, matching how TPU training actually dies:
   split exists to absorb), and :class:`ProcessKillInjector` SIGKILLs a
   process-backed replica's worker on a scheduled pump tick (the REAL
   kill -9 the in-process injectors only imitate — drives
-  ``ProcReplica``'s corpse-discovery + shadow-salvage path).
+  ``ProcReplica``'s corpse-discovery + shadow-salvage path) and on a
+  scheduled SWAP beat (``swap_tick`` — kill-mid-swap, driving the
+  train-while-serve heal-onto-newest-valid-publication path);
+- **train-while-serve faults**: :class:`TornPublishInjector` proxies a
+  :class:`~rocket_tpu.persist.publish.WeightPublisher` and tears
+  scheduled publications in place right after they commit —
+  ``'uncommit'`` drops the marker (shallow verify catches it),
+  ``'garble'`` flips bytes in one leaf while the marker survives (only
+  the swap gate's DEEP verify catches it) — driving the
+  publish-rejected path: counter + flight dump, serving untouched.
 
 Everything here is deterministic (iteration- or call-indexed, never
 random) so chaos tests replay exactly.
@@ -283,11 +292,22 @@ class ProcessKillInjector:
     replica twice.
     """
 
-    def __init__(self, replica: Any, kill_on: Iterable[int] = (0,)) -> None:
+    def __init__(self, replica: Any, kill_on: Iterable[int] = (0,),
+                 swap_kill_on: Iterable[int] = ()) -> None:
         self._replica = replica
         self._kill_on = set(int(i) for i in kill_on)
-        self.ticks = 0   # tick() calls seen
-        self.kills = 0   # SIGKILLs actually delivered
+        self._swap_kill_on = set(int(i) for i in swap_kill_on)
+        self.ticks = 0       # tick() calls seen
+        self.swap_ticks = 0  # swap_tick() calls seen
+        self.kills = 0       # SIGKILLs actually delivered
+
+    def _kill(self) -> bool:
+        try:
+            self._replica.kill()
+        except (ProcessLookupError, OSError):
+            return False    # already a corpse — nothing to kill
+        self.kills += 1
+        return True
 
     def tick(self) -> bool:
         """Advance the chaos clock; returns True if this tick killed."""
@@ -295,12 +315,76 @@ class ProcessKillInjector:
         self.ticks += 1
         if pos not in self._kill_on:
             return False
-        try:
-            self._replica.kill()
-        except (ProcessLookupError, OSError):
-            return False    # already a corpse — nothing to kill
-        self.kills += 1
-        return True
+        return self._kill()
+
+    def swap_tick(self) -> bool:
+        """The kill-mid-swap clock: the chaos driver calls this once per
+        weight-swap beat, IMMEDIATELY BEFORE the NEW_WEIGHTS RPC goes
+        out.  A scheduled beat SIGKILLs the worker so the swap RPC hits
+        a corpse: the supervisor discovers the death from the failed
+        RPC, and the heal's respawn elects the newest VALID publication
+        (``restore_params`` scans the publish tier) — the killed swap
+        is not lost, it is re-converged through restore."""
+        pos = self.swap_ticks
+        self.swap_ticks += 1
+        if pos not in self._swap_kill_on:
+            return False
+        return self._kill()
+
+
+class TornPublishInjector:
+    """Proxy a ``WeightPublisher`` and tear scheduled publications.
+
+    ``tear_on`` maps publish-call indexes (0 = first ``publish()``
+    through this proxy) to a :func:`corrupt_snapshot` mode; a scheduled
+    publication is corrupted IN PLACE right after the publisher commits
+    it — the write succeeded from the trainer's point of view, the tear
+    happens on disk afterwards, which is exactly the window the swap
+    gate's verify exists for:
+
+    - ``'uncommit'`` drops the ``_COMMITTED`` marker — the publication
+      becomes invisible to :func:`~rocket_tpu.persist.publish.
+      latest_publication` (a feed never even offers it);
+    - ``'garble'`` flips bytes in one leaf while marker + manifest
+      survive — the feed DOES offer it, and only the worker-side
+      ``verify(deep=True)`` checksum pass rejects it
+      (``publish_rejected`` + flight dump, serving untouched);
+    - ``'drop_item'`` removes an item directory — shallow verify fails.
+
+    Everything else delegates to the wrapped publisher, so the proxy
+    drops in wherever a ``WeightPublisher`` is used (including inside a
+    ``Checkpointer`` via its ``_publisher`` attribute).
+    """
+
+    _OWN = ("_pub", "_tear_on", "published", "tears")
+
+    def __init__(self, publisher: Any,
+                 tear_on: Optional[dict] = None) -> None:
+        object.__setattr__(self, "_pub", publisher)
+        object.__setattr__(self, "_tear_on",
+                           {int(k): str(v)
+                            for k, v in (tear_on or {0: "uncommit"}).items()})
+        object.__setattr__(self, "published", 0)  # publish() calls seen
+        object.__setattr__(self, "tears", 0)      # publications torn
+
+    def publish(self, *args: Any, **kwargs: Any) -> Any:
+        pos = self.published
+        object.__setattr__(self, "published", pos + 1)
+        path = self._pub.publish(*args, **kwargs)
+        mode = self._tear_on.get(pos)
+        if mode is not None and path is not None:
+            corrupt_snapshot(path, mode)
+            object.__setattr__(self, "tears", self.tears + 1)
+        return path
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(object.__getattribute__(self, "_pub"), name)
+
+    def __setattr__(self, name: str, value: Any) -> None:
+        if name in self._OWN:
+            object.__setattr__(self, name, value)
+        else:
+            setattr(self._pub, name, value)
 
 
 class SlowPrefillInjector:

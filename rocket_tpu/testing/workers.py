@@ -154,6 +154,35 @@ def save_tiny_snapshot(root: str, *, seed_target: int = SEED_TARGET) -> str:
     return path
 
 
+def save_tiny_publication(root: str, *, step: int,
+                          seed_target: int = SEED_TARGET,
+                          trainer_layout: bool = False) -> str:
+    """Publish the tiny target params under ``<root>/publish/`` via the
+    real :class:`~rocket_tpu.persist.publish.WeightPublisher` (two-phase
+    commit, checksummed, mesh-stamped manifest) and return the
+    publication path — the train-while-serve stand-in for a live
+    trainer's ``Checkpointer(publish_every=N)`` beat.  A DIFFERENT
+    ``seed_target`` than the serving default proves a swap actually
+    happened: post-swap tokens match the publication-seed oracle, not
+    the boot weights.  ``trainer_layout=True`` publishes the nested
+    TrainState shape a real trainer's capsules hold, exercising the swap
+    path's manifest-guided params location + partial restore."""
+    import jax
+
+    from rocket_tpu.persist.publish import WeightPublisher
+
+    _, _, params, _ = tiny_models(seed_target=seed_target)
+    mesh = jax.sharding.Mesh(
+        np.asarray(jax.devices()).reshape(-1), ("data",))
+    if trainer_layout:
+        items = {"model": {"state": {"params": params,
+                                     "step": np.int32(step)}}}
+    else:
+        items = {"params": params}
+    pub = WeightPublisher(os.path.abspath(root))
+    return pub.publish(items, step=int(step), mesh=mesh)
+
+
 def save_tiny_emergency(root: str, *, seed_target: int = SEED_TARGET,
                         iter_idx: int = 3,
                         trainer_layout: bool = False) -> str:

@@ -1,0 +1,651 @@
+"""Train-while-serve tests — verified publication, live weight hot-swap,
+rejected torn publishes, bounded rollback, kill-mid-swap healing.
+
+Three layers (see docs/reliability.md "Live weight updates"):
+
+- units (no subprocess): the publisher's two-phase commit + pruning,
+  publication election skipping torn saves, the versioned wire
+  handshake, the swap-version merge semantics on the export surface,
+  the chaos injectors, and the WeightFeed's offer/reject bookkeeping;
+- in-process swap path: verify → locate → check_reshard → host restore
+  → donation swap, bit-equal to a fresh-built server on the published
+  seed; rejected garbled/uncommitted publications (counter + flight
+  dump, old weights keep serving); bounded rollback; the reshard gate
+  refusing an incompatible publication with the TopologyMismatch
+  naming;
+- process fleet (heavy tail / ``slow``): the acceptance trio — a
+  seeded trace served across a live publish+swap with every request
+  typed exactly once and post-swap tokens bit-equal to a fresh-loaded
+  server; a torn publication rejected over the RPC path; SIGKILL
+  mid-swap healing onto the newest valid publication.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from rocket_tpu.observe import export
+from rocket_tpu.persist import integrity
+from rocket_tpu.persist.publish import (
+    PUBLISH_SUBDIR,
+    WeightPublisher,
+    latest_publication,
+)
+from rocket_tpu.serve import ProcReplica, Request, WeightFeed, wire
+from rocket_tpu.serve.feed import register_swap_source
+from rocket_tpu.testing import workers as tw
+from rocket_tpu.testing.chaos import (
+    ProcessKillInjector,
+    TornPublishInjector,
+    corrupt_snapshot,
+)
+
+pytestmark = pytest.mark.trainserve
+
+BUILDER = "rocket_tpu.testing.workers:build_tiny_loop"
+SPAWN_S = 240.0     # worker spawn includes a jax import + model init
+SEED_PUB = 5        # publication seed != builder default (tw.SEED_TARGET)
+
+
+@pytest.fixture(autouse=True)
+def _clean_export_sources():
+    yield
+    export.unregister_source("serve_swap")
+
+
+def _serve_one(loop, rid, prompt, max_new=8, rounds=200):
+    loop.submit(Request(rid=rid, prompt=prompt, max_new_tokens=max_new))
+    out = []
+    for _ in range(rounds):
+        loop.run_round()
+        out.extend(loop.drain_results())
+        if out:
+            return out[0]
+    raise AssertionError(f"request {rid} never completed")
+
+
+@pytest.fixture(scope="module")
+def prompt():
+    return np.arange(1, 7, dtype=np.int32)
+
+
+@pytest.fixture(scope="module")
+def oracle_tokens(prompt):
+    """rid-free oracles: expected tokens for the boot seed and the
+    publication seed, from fresh single-purpose loops."""
+    boot = _serve_one(tw.build_tiny_loop(), "oracle-boot", prompt)
+    pub = _serve_one(tw.build_tiny_loop(seed_target=SEED_PUB),
+                     "oracle-pub", prompt)
+    assert not np.array_equal(boot.tokens, pub.tokens), \
+        "seeds must produce distinguishable tokens"
+    return {"boot": np.asarray(boot.tokens), "pub": np.asarray(pub.tokens)}
+
+
+# -- publisher units ---------------------------------------------------------
+
+
+class TestPublisher:
+    def test_two_phase_commit_and_manifest(self, tmp_path, devices):
+        path = tw.save_tiny_publication(str(tmp_path), step=7,
+                                        seed_target=SEED_PUB)
+        assert os.path.isfile(os.path.join(path, integrity.COMMIT_MARKER))
+        manifest = integrity.read_manifest(path)
+        assert manifest["iter_idx"] == 7
+        assert manifest.get("mesh") is not None
+        ok, reason = integrity.verify(path, deep=True)
+        assert ok, reason
+        assert latest_publication(str(tmp_path)) == (7, path)
+
+    def test_election_orders_by_step_and_skips_torn(self, tmp_path,
+                                                    devices):
+        p1 = tw.save_tiny_publication(str(tmp_path), step=10)
+        p2 = tw.save_tiny_publication(str(tmp_path), step=20)
+        assert latest_publication(str(tmp_path)) == (20, p2)
+        # tearing the newest makes it INVISIBLE: election falls back
+        corrupt_snapshot(p2, "uncommit")
+        assert latest_publication(str(tmp_path)) == (10, p1)
+        # a garbled publication still LOOKS committed shallow...
+        corrupt_snapshot(p1, "garble")
+        assert latest_publication(str(tmp_path)) == (10, p1)
+        # ...and only the deep election catches it
+        assert latest_publication(str(tmp_path), deep=True) is None
+
+    def test_prune_keeps_newest_and_rollback_target(self, tmp_path,
+                                                    devices):
+        import jax
+
+        _, _, params, _ = tw.tiny_models()
+        pub = WeightPublisher(str(tmp_path), keep=2)
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(-1), ("data",))
+        paths = [pub.publish({"params": params}, step=s, mesh=mesh)
+                 for s in (1, 2, 3)]
+        assert not os.path.isdir(paths[0])       # pruned
+        assert os.path.isdir(paths[1])           # the rollback target
+        assert os.path.isdir(paths[2])
+        assert pub.publishes == 3
+
+    def test_keep_below_two_refused(self, tmp_path):
+        with pytest.raises(ValueError, match="rollback"):
+            WeightPublisher(str(tmp_path), keep=1)
+
+    def test_publish_subdir_not_in_trainer_election(self, tmp_path,
+                                                    devices):
+        """A params-only publication must never be elected by a trainer
+        resume — the publish subdir stays out of DEFAULT_SUBDIRS."""
+        assert PUBLISH_SUBDIR not in integrity.DEFAULT_SUBDIRS
+        tw.save_tiny_publication(str(tmp_path), step=5)
+        assert integrity.latest_valid(str(tmp_path),
+                                      do_quarantine=False) is None
+
+    def test_checkpointer_publishes_on_cadence(self, tmp_path, devices):
+        """Checkpointer(publish_every=2) drops committed publications on
+        the training cadence, stamped with the training step."""
+        import rocket_tpu as rt
+        from rocket_tpu.models.objectives import cross_entropy
+
+        from test_pipeline import MLP, synthetic_classification
+
+        data = synthetic_classification(n=128)
+        model = rt.Module(
+            MLP(),
+            capsules=[
+                rt.Loss(cross_entropy(labels_key="label"), name="ce"),
+                rt.Optimizer(learning_rate=2e-2),
+            ],
+        )
+        looper = rt.Looper(
+            capsules=[
+                rt.Dataset(rt.ArraySource(data), batch_size=32,
+                           shuffle=True, seed=7),
+                model,
+                rt.Checkpointer(save_every=None, publish_every=2),
+            ],
+            progress=False,
+        )
+        launcher = rt.Launcher(capsules=[looper], tag="pub",
+                               num_epochs=1, project_root=str(tmp_path),
+                               seed=0)
+        launcher.launch()
+        root = str(tmp_path / "pub" / "v0")
+        latest = latest_publication(root)
+        assert latest is not None
+        version, path = latest
+        # 4 iterations/epoch at batch 32 → publishes after iters 1 and 3
+        assert version == 3
+        ok, reason = integrity.verify(path, deep=True)
+        assert ok, reason
+        # keep=2: at most two publications retained
+        pubs = os.listdir(os.path.join(root, PUBLISH_SUBDIR))
+        assert len([d for d in pubs if not d.startswith("_")]) <= 2
+
+
+# -- wire handshake units ----------------------------------------------------
+
+
+class TestWireProtocol:
+    def test_hello_roundtrip(self):
+        spec = wire.WorkerSpec(builder=BUILDER)
+        assert wire.check_hello(wire.hello_payload(spec)) is spec
+
+    def test_bare_spec_is_version_zero(self):
+        with pytest.raises(wire.ProtocolMismatch) as ei:
+            wire.check_hello(wire.WorkerSpec(builder=BUILDER))
+        assert ei.value.theirs == 0 and ei.value.side == "worker"
+
+    def test_mismatch_names_remedy(self):
+        with pytest.raises(wire.ProtocolMismatch) as ei:
+            wire.check_hello({"proto": wire.PROTOCOL_VERSION + 1,
+                              "spec": wire.WorkerSpec(builder=BUILDER)})
+        msg = str(ei.value)
+        assert "Remedy" in msg and "PROTOCOL_VERSION" in msg
+        assert ei.value.ours == wire.PROTOCOL_VERSION
+        assert ei.value.theirs == wire.PROTOCOL_VERSION + 1
+
+    def test_hello_without_spec_refused(self):
+        with pytest.raises(ValueError, match="WorkerSpec"):
+            wire.check_hello({"proto": wire.PROTOCOL_VERSION})
+
+    def test_ready_checks_both_directions(self):
+        info = wire.check_ready({"proto": wire.PROTOCOL_VERSION, "pid": 1})
+        assert info["pid"] == 1
+        with pytest.raises(wire.ProtocolMismatch) as ei:
+            wire.check_ready({"pid": 1})    # pre-versioning READY
+        assert ei.value.theirs == 0 and ei.value.side == "supervisor"
+
+
+# -- in-process swap path ----------------------------------------------------
+
+
+class TestSwap:
+    def test_swap_bit_equal_to_fresh_server(self, tmp_path, devices,
+                                            prompt, oracle_tokens):
+        loop = tw.build_tiny_loop()
+        before = _serve_one(loop, "pre", prompt)
+        assert np.array_equal(before.tokens, oracle_tokens["boot"])
+        path = tw.save_tiny_publication(str(tmp_path), step=10,
+                                        seed_target=SEED_PUB)
+        assert loop.swap_weights(path)
+        assert loop.weights_version == 10
+        assert loop.counters.swaps == 1
+        assert loop.counters.weights_version == 10
+        assert loop.counters.swap_ms_total > 0.0
+        after = _serve_one(loop, "post", prompt)
+        assert np.array_equal(after.tokens, oracle_tokens["pub"])
+
+    def test_swap_trainer_layout_partial_restore(self, tmp_path, devices,
+                                                 prompt, oracle_tokens):
+        """A trainer publishes its whole TrainState; the swap locates the
+        params subtree through the manifest and restores ONLY it."""
+        loop = tw.build_tiny_loop()
+        path = tw.save_tiny_publication(str(tmp_path), step=20,
+                                        seed_target=SEED_PUB,
+                                        trainer_layout=True)
+        assert loop.swap_weights(path)
+        after = _serve_one(loop, "post", prompt)
+        assert np.array_equal(after.tokens, oracle_tokens["pub"])
+
+    def test_inflight_rows_survive_swap(self, tmp_path, devices, prompt,
+                                        oracle_tokens):
+        """A row mid-decode keeps its KV pages across the swap and
+        finishes — typed exactly once, no failure, no eviction."""
+        loop = tw.build_tiny_loop()
+        loop.submit(Request(rid="inflight", prompt=prompt,
+                            max_new_tokens=12))
+        for _ in range(3):          # start decoding, don't finish
+            loop.run_round()
+        assert loop.load > 0
+        path = tw.save_tiny_publication(str(tmp_path), step=30,
+                                        seed_target=SEED_PUB)
+        assert loop.swap_weights(path)
+        out = []
+        for _ in range(200):
+            loop.run_round()
+            out.extend(loop.drain_results())
+            if out:
+                break
+        assert len(out) == 1 and out[0].rid == "inflight"
+        assert type(out[0]).__name__ == "Completed"
+        assert loop.counters.failed == 0
+
+    def test_garbled_publication_rejected(self, tmp_path, devices, prompt,
+                                          oracle_tokens):
+        """Deep verify catches a garbled leaf: counter + flight dump,
+        serving continues on the old weights untouched."""
+        from rocket_tpu.models.generate import ContinuousBatcher
+        from rocket_tpu.observe.recorder import FlightRecorder
+        from rocket_tpu.serve.loop import ServingLoop
+
+        model, draft, params, dparams = tw.tiny_models()
+        rec = FlightRecorder(out_dir=str(tmp_path / "flightrec"))
+        loop = ServingLoop(
+            lambda: ContinuousBatcher(model, draft, params, dparams,
+                                      total_len=tw.TOTAL,
+                                      n_draft=tw.NDRAFT, eos_token=None),
+            max_batch=tw.B, recorder=rec,
+        )
+        path = tw.save_tiny_publication(str(tmp_path), step=40,
+                                        seed_target=SEED_PUB)
+        corrupt_snapshot(path, "garble")
+        assert not loop.swap_weights(path)
+        assert loop.counters.publish_rejected == 1
+        assert loop.counters.swaps == 0
+        assert loop.weights_version == -1
+        # the flight dump landed for the post-mortem
+        assert rec.last_dump is not None
+        assert "publish-rejected" in rec.last_dump
+        # old weights keep serving bit-correct
+        out = _serve_one(loop, "still-boot", prompt)
+        assert np.array_equal(out.tokens, oracle_tokens["boot"])
+
+    def test_uncommitted_publication_rejected(self, tmp_path, devices):
+        loop = tw.build_tiny_loop()
+        path = tw.save_tiny_publication(str(tmp_path), step=50)
+        corrupt_snapshot(path, "uncommit")
+        assert not loop.swap_weights(path)
+        assert loop.counters.publish_rejected == 1
+
+    def test_incompatible_publication_refused_by_reshard_gate(
+            self, tmp_path, devices):
+        """A publication whose shapes do not match the serving model is
+        a model change, not a hot-swap — the check_reshard gate refuses
+        it with the TopologyMismatch naming, serving untouched."""
+        import jax
+
+        from rocket_tpu.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+
+        cfg = TransformerConfig(vocab_size=tw.VOCAB, hidden=tw.HIDDEN * 2,
+                                n_layers=tw.LAYERS, n_heads=tw.HEADS,
+                                max_seq=tw.MAX_SEQ)
+        wrong = TransformerLM(cfg).init(
+            jax.random.PRNGKey(0),
+            {"tokens": np.zeros((1, tw.P), np.int32),
+             "positions": np.zeros((1, tw.P), np.int32)},
+        )["params"]
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(-1), ("data",))
+        pub = WeightPublisher(str(tmp_path))
+        path = pub.publish({"params": wrong}, step=60, mesh=mesh)
+        loop = tw.build_tiny_loop()
+        assert not loop.swap_weights(path)
+        assert loop.counters.publish_rejected == 1
+        assert loop.weights_version == -1
+
+    def test_rollback_is_bounded_to_previous_version(self, tmp_path,
+                                                     devices, prompt,
+                                                     oracle_tokens):
+        loop = tw.build_tiny_loop()
+        p1 = tw.save_tiny_publication(str(tmp_path), step=10,
+                                      seed_target=SEED_PUB)
+        p2 = tw.save_tiny_publication(str(tmp_path), step=20,
+                                      seed_target=11)
+        assert loop.swap_weights(p1) and loop.swap_weights(p2)
+        assert loop.weights_version == 20
+        # divergence noticed → one bounded step back
+        assert loop.rollback_weights()
+        assert loop.weights_version == 10
+        assert loop.counters.swap_rollbacks == 1
+        out = _serve_one(loop, "rolled", prompt)
+        assert np.array_equal(out.tokens, oracle_tokens["pub"])
+        # bounded: there is no version before the previous one
+        assert not loop.rollback_weights()
+        assert loop.weights_version == 10
+
+    def test_watchdog_rebuild_after_swap_keeps_swapped_weights(
+            self, tmp_path, devices, prompt, oracle_tokens):
+        """The donation swap deletes the factory closure's original
+        leaves — a watchdog rebuild must come back on the SWAPPED
+        weights, not the donated-away originals."""
+        loop = tw.build_tiny_loop()
+        path = tw.save_tiny_publication(str(tmp_path), step=70,
+                                        seed_target=SEED_PUB)
+        assert loop.swap_weights(path)
+        loop._rebuild()
+        out = _serve_one(loop, "rebuilt", prompt)
+        assert np.array_equal(out.tokens, oracle_tokens["pub"])
+
+
+# -- chaos injector units ----------------------------------------------------
+
+
+class TestInjectors:
+    def test_torn_publish_injector_schedules(self, tmp_path, devices):
+        import jax
+
+        _, _, params, _ = tw.tiny_models()
+        mesh = jax.sharding.Mesh(
+            np.asarray(jax.devices()).reshape(-1), ("data",))
+        pub = TornPublishInjector(
+            WeightPublisher(str(tmp_path), keep=3),
+            tear_on={0: "uncommit", 2: "garble"},
+        )
+        p0 = pub.publish({"params": params}, step=1, mesh=mesh)
+        p1 = pub.publish({"params": params}, step=2, mesh=mesh)
+        p2 = pub.publish({"params": params}, step=3, mesh=mesh)
+        assert pub.published == 3 and pub.tears == 2
+        # torn #0: no marker → invisible even shallow
+        assert not integrity.verify(p0)[0]
+        # untouched #1: fully valid
+        assert integrity.verify(p1, deep=True)[0]
+        # garbled #2: committed shallow, caught only deep
+        assert integrity.verify(p2)[0]
+        assert not integrity.verify(p2, deep=True)[0]
+        # delegation: the wrapped publisher's own counter advanced
+        assert pub.publishes == 3
+
+    def test_swap_tick_schedule(self):
+        class FakeReplica:
+            kills = 0
+
+            def kill(self):
+                self.kills += 1
+
+        rep = FakeReplica()
+        inj = ProcessKillInjector(rep, kill_on=(), swap_kill_on=(1,))
+        assert not inj.swap_tick()      # beat 0: spared
+        assert inj.swap_tick()          # beat 1: killed
+        assert not inj.swap_tick()      # beat 2: spared
+        assert rep.kills == 1 and inj.kills == 1
+        # the pump-tick clock is independent
+        assert inj.ticks == 0
+
+
+# -- feed + export surface ---------------------------------------------------
+
+
+class _FakeReplica:
+    def __init__(self, rid, accept=True):
+        self.replica_id = rid
+        self._accept = accept
+        self.weights_version = -1
+        self.swap_calls = 0
+        self.rollback_calls = 0
+
+    def swap_weights(self, path, version, deep_verify=True):
+        self.swap_calls += 1
+        if self._accept:
+            self.weights_version = version
+            return True
+        return False
+
+    def rollback_weights(self):
+        self.rollback_calls += 1
+        self.weights_version = max(-1, self.weights_version - 10)
+        return True
+
+
+class TestWeightFeed:
+    def test_poll_offers_only_to_stale_replicas(self, tmp_path, devices):
+        tw.save_tiny_publication(str(tmp_path), step=10)
+        fresh, stale = _FakeReplica("a"), _FakeReplica("b")
+        fresh.weights_version = 10
+        feed = WeightFeed(str(tmp_path), [fresh, stale])
+        assert feed.poll() == 1
+        assert fresh.swap_calls == 0 and stale.swap_calls == 1
+        assert stale.weights_version == 10
+        # a second poll is a no-op: everyone is current
+        assert feed.poll() == 0 and stale.swap_calls == 1
+
+    def test_rejected_publication_not_reoffered(self, tmp_path, devices):
+        tw.save_tiny_publication(str(tmp_path), step=10)
+        rep = _FakeReplica("a", accept=False)
+        feed = WeightFeed(str(tmp_path), [rep])
+        assert feed.poll() == 0
+        assert feed.rejects == 1 and rep.swap_calls == 1
+        # known-bad path: never offered again
+        assert feed.poll() == 0 and rep.swap_calls == 1
+        # a NEWER publication supersedes the rejection
+        rep._accept = True
+        tw.save_tiny_publication(str(tmp_path), step=20)
+        assert feed.poll() == 1 and rep.weights_version == 20
+
+    def test_rollback_fans_out(self, tmp_path, devices):
+        reps = [_FakeReplica("a"), _FakeReplica("b")]
+        feed = WeightFeed(str(tmp_path), reps)
+        assert feed.rollback() == 2
+        assert all(r.rollback_calls == 1 for r in reps)
+        assert feed.rollbacks == 2
+
+    def test_swap_source_on_export_surface(self, tmp_path, devices):
+        tw.save_tiny_publication(str(tmp_path), step=10)
+        feed = WeightFeed(str(tmp_path), [_FakeReplica("a")])
+        assert register_swap_source(feed) == "serve_swap"
+        feed.poll()
+        snap = export.collect()
+        assert snap["serve_swap/swaps"] == 1.0
+        assert snap["serve_swap/version"] == 10.0
+
+    def test_version_gauge_merges_max_counters_sum(self):
+        merged = export.merge_counters([
+            {"serve_swap/swaps": 2.0, "serve_swap/version": 10.0,
+             "serve_fleet/r0/weights_version": 10.0},
+            {"serve_swap/swaps": 1.0, "serve_swap/version": 20.0,
+             "serve_fleet/r0/weights_version": 20.0},
+        ])
+        assert merged["serve_swap/swaps"] == 3.0        # counter: SUM
+        assert merged["serve_swap/version"] == 20.0     # gauge: MAX
+        assert merged["serve_fleet/r0/weights_version"] == 20.0
+
+
+# -- goodput bucket ----------------------------------------------------------
+
+
+def test_swap_goodput_bucket(tmp_path, devices):
+    """Swap wall time lands in the ``swap`` bucket (not unattributed),
+    and the counter agrees with the ledger."""
+    from rocket_tpu.observe.ledger import (
+        GoodputLedger,
+        arm_ledgers,
+        disarm_ledgers,
+        get_goodput,
+    )
+
+    assert "swap" in GoodputLedger.BUCKETS
+    assert "swap" in GoodputLedger.NESTED
+    loop = tw.build_tiny_loop()
+    path = tw.save_tiny_publication(str(tmp_path), step=10,
+                                    seed_target=SEED_PUB)
+    arm_ledgers()
+    try:
+        before = get_goodput().snapshot().get("swap_s", 0.0)
+        assert loop.swap_weights(path)
+        delta_s = get_goodput().snapshot()["swap_s"] - before
+    finally:
+        disarm_ledgers()
+    assert delta_s > 0.0
+    assert abs(delta_s * 1e3 - loop.counters.swap_ms_total) \
+        < 0.2 * loop.counters.swap_ms_total + 50.0
+
+
+# -- process fleet acceptance ------------------------------------------------
+
+
+def _drain_replica(rep, want, timeout=60.0):
+    results = []
+    deadline = time.monotonic() + timeout
+    while len(results) < want and time.monotonic() < deadline:
+        rep.pump()
+        results.extend(rep.drain_results())
+    return results
+
+
+def test_live_swap_across_process_fleet(tmp_path, devices, prompt,
+                                        oracle_tokens):
+    """Acceptance (a): a seeded trace served during a live publish —
+    every request typed exactly once, post-swap tokens bit-equal to a
+    fresh-loaded server at the published step."""
+    rep = ProcReplica(wire.WorkerSpec(builder=BUILDER), "ts-0",
+                      spawn_timeout_s=SPAWN_S, rpc_timeout_s=SPAWN_S)
+    try:
+        assert rep.submit(Request(rid="pre", prompt=prompt,
+                                  max_new_tokens=8))
+        pre = _drain_replica(rep, 1)
+        assert [r.rid for r in pre] == ["pre"]
+        assert np.array_equal(pre[0].tokens, oracle_tokens["boot"])
+
+        # the trainer publishes; the feed pushes it to the fleet
+        tw.save_tiny_publication(str(tmp_path), step=10,
+                                 seed_target=SEED_PUB)
+        feed = WeightFeed(str(tmp_path), [rep])
+        assert feed.poll() == 1
+        assert rep.weights_version == 10
+        assert feed.snapshot()["version"] == 10.0
+
+        assert rep.submit(Request(rid="post", prompt=prompt,
+                                  max_new_tokens=8))
+        post = _drain_replica(rep, 1)
+        assert [r.rid for r in post] == ["post"]
+        assert np.array_equal(post[0].tokens, oracle_tokens["pub"])
+
+        # rollback over the wire restores the boot-equivalent? No — the
+        # previous version was the factory seed, never published; the
+        # worker correctly refuses a rollback with no published prior.
+        assert not rep.rollback_weights()
+    finally:
+        rep.close()
+
+
+def test_torn_publication_rejected_across_fleet(tmp_path, devices, prompt,
+                                                oracle_tokens):
+    """Acceptance (b): a garbled publication is rejected worker-side —
+    counter visible over the RPC surface, old weights keep serving, and
+    the feed stops re-offering the known-bad path."""
+    rep = ProcReplica(wire.WorkerSpec(builder=BUILDER), "ts-torn",
+                      spawn_timeout_s=SPAWN_S, rpc_timeout_s=SPAWN_S)
+    try:
+        path = tw.save_tiny_publication(str(tmp_path), step=10,
+                                        seed_target=SEED_PUB)
+        corrupt_snapshot(path, "garble")
+        feed = WeightFeed(str(tmp_path), [rep])
+        assert feed.poll() == 0
+        assert feed.rejects == 1
+        assert rep.weights_version == -1
+        assert rep.counters.get("publish_rejected") == 1.0
+        # serving is untouched: boot weights, bit-correct
+        assert rep.submit(Request(rid="still", prompt=prompt,
+                                  max_new_tokens=8))
+        out = _drain_replica(rep, 1)
+        assert np.array_equal(out[0].tokens, oracle_tokens["boot"])
+        assert feed.poll() == 0 and feed.pushes == 1   # not re-offered
+    finally:
+        rep.close()
+
+
+@pytest.mark.slow
+def test_kill_mid_swap_heals_onto_newest_valid(tmp_path, devices, prompt,
+                                               oracle_tokens):
+    """Acceptance (c): SIGKILL just before the swap RPC — the supervisor
+    discovers the corpse, salvages exactly-once, and the respawn
+    elastic-restores onto the newest VALID publication."""
+    spec = wire.WorkerSpec(builder=BUILDER, restore_dir=str(tmp_path))
+    # nothing published yet: the spawn falls back to... nothing to
+    # restore would fail — publish v1 BEFORE the first spawn.
+    tw.save_tiny_publication(str(tmp_path), step=10,
+                             seed_target=SEED_PUB)
+    rep = ProcReplica(spec, "ts-kill", spawn_timeout_s=SPAWN_S,
+                      rpc_timeout_s=SPAWN_S)
+    inj = ProcessKillInjector(rep, kill_on=(), swap_kill_on=(0,))
+    try:
+        # the worker restored the v1 publication at spawn
+        assert rep.submit(Request(rid="pre", prompt=prompt,
+                                  max_new_tokens=8))
+        pre = _drain_replica(rep, 1)
+        assert np.array_equal(pre[0].tokens, oracle_tokens["pub"])
+
+        # a NEWER publication lands; a torn one lands after it
+        p2 = tw.save_tiny_publication(str(tmp_path), step=20,
+                                      seed_target=11)
+        p3 = tw.save_tiny_publication(str(tmp_path), step=30,
+                                      seed_target=13)
+        corrupt_snapshot(p3, "uncommit")
+
+        # in-flight work at the moment of death → must salvage
+        assert rep.submit(Request(rid="inflight", prompt=prompt,
+                                  max_new_tokens=8))
+
+        inj.swap_tick()                       # SIGKILL before the RPC
+        deadline = time.monotonic() + 10.0
+        while rep.proc.poll() is None and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert not rep.swap_weights(p2, 20)   # hits the corpse
+        assert rep.health.value == "draining"
+
+        final, salvaged = rep.heal()
+        # exactly-once: the unanswered request salvages, nothing final
+        assert [r.rid for r in salvaged] == ["inflight"]
+        assert not final
+        # the respawn elected the newest VALID snapshot: the committed
+        # v20 publication, not the torn v30
+        assert rep.submit(Request(rid="post", prompt=prompt,
+                                  max_new_tokens=8))
+        post = _drain_replica(rep, 1)
+        oracle20 = _serve_one(tw.build_tiny_loop(seed_target=11),
+                              "oracle20", prompt)
+        assert np.array_equal(post[0].tokens, oracle20.tokens)
+    finally:
+        rep.close()
