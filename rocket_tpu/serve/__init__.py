@@ -26,11 +26,22 @@ from rocket_tpu.serve.kvstore import (
     page_hashes,
     register_kvstore_source,
 )
+from rocket_tpu.serve.loadgen import (
+    ReplayReport,
+    TenantSpec,
+    TraceConfig,
+    TraceEvent,
+    replay_trace,
+    synth_trace,
+)
 from rocket_tpu.serve.loop import ServingLoop
 from rocket_tpu.serve.metrics import (
+    DEFAULT_SLO_TARGETS,
+    ClassLatency,
     FleetCounters,
     ServeCounters,
     ServeLatency,
+    register_slo_source,
 )
 from rocket_tpu.serve.policy import (
     DEFAULT_LADDER,
@@ -38,14 +49,16 @@ from rocket_tpu.serve.policy import (
     DegradationPolicy,
 )
 from rocket_tpu.serve.procfleet import ProcReplica
-from rocket_tpu.serve.queue import AdmissionQueue
+from rocket_tpu.serve.queue import DEFAULT_CLASS_WEIGHTS, AdmissionQueue
 from rocket_tpu.serve.router import FleetRouter
 from rocket_tpu.serve.types import (
+    SLO_CLASSES,
     Completed,
     DeadlineExceeded,
     Failed,
     HealthState,
     Overloaded,
+    PreemptTicket,
     ReplicaId,
     Request,
     Result,
@@ -57,8 +70,11 @@ __all__ = [
     "AdmissionQueue",
     "Autoscaler",
     "AutoscaleCounters",
+    "ClassLatency",
     "Completed",
+    "DEFAULT_CLASS_WEIGHTS",
     "DEFAULT_LADDER",
+    "DEFAULT_SLO_TARGETS",
     "DeadlineExceeded",
     "DegradationLevel",
     "DegradationPolicy",
@@ -70,25 +86,33 @@ __all__ = [
     "KVPagePool",
     "KVPoolClient",
     "Overloaded",
+    "PreemptTicket",
     "PrefillReplica",
     "PrefixKVStore",
     "PrefixMatch",
     "ProcReplica",
     "Replica",
+    "ReplayReport",
     "ReplicaId",
     "Request",
     "Result",
+    "SLO_CLASSES",
     "SLOPolicy",
     "ServeCounters",
     "ServeLatency",
     "ServingLoop",
     "SharedPrefixIndex",
+    "TenantSpec",
+    "TraceConfig",
+    "TraceEvent",
     "WeightFeed",
     "WorkerSpec",
     "page_hashes",
     "register_fleet_source",
     "register_kvpool_source",
     "register_kvstore_source",
+    "register_slo_source",
     "register_swap_source",
-    "successive_halving_capacity",
+    "replay_trace",
+    "synth_trace",
 ]

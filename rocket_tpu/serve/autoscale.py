@@ -85,6 +85,7 @@ class AutoscaleCounters:
     def __init__(self) -> None:
         self.polls = 0
         self.breach_ttft = 0
+        self.breach_class_ttft = 0  # per-class SLO breaches (non-batch)
         self.breach_shed = 0
         self.scale_ups = 0
         self.scale_downs = 0
@@ -101,6 +102,7 @@ class AutoscaleCounters:
         return {
             "polls": float(self.polls),
             "breach_ttft": float(self.breach_ttft),
+            "breach_class_ttft": float(self.breach_class_ttft),
             "breach_shed": float(self.breach_shed),
             "scale_ups": float(self.scale_ups),
             "scale_downs": float(self.scale_downs),
@@ -166,11 +168,20 @@ class Autoscaler:
                  spawn_fn: Callable[[str], Any],
                  policy: Optional[SLOPolicy] = None, *,
                  source: str = "serve_fleet",
+                 class_policies: Optional[Dict[str, SLOPolicy]] = None,
+                 slo_source: str = "serve_slo",
                  collect_fn: Callable[[], Dict[str, float]] = export.collect,
                  clock: Callable[[], float] = time.monotonic,
                  logger: Optional[logging.Logger] = None) -> None:
         self.router = router
         self.policy = policy or SLOPolicy()
+        # Multi-tenant serving: an SLOPolicy PER CLASS, checked against
+        # the ``serve_slo/<cls>/ttft_ms/p95`` gauges.  The batch class
+        # never triggers a scale-up — its backlog is answered by
+        # preemption and weighted fairness, and spending chips on batch
+        # latency would defeat the troughs-filling economics.
+        self.class_policies = dict(class_policies or {})
+        self._slo_source = slo_source
         self.counters = AutoscaleCounters()
         self._spawn_fn = spawn_fn
         self._source = source
@@ -296,6 +307,13 @@ class Autoscaler:
         if self._shed_rate(metrics) > self.policy.max_shed_rate:
             self.counters.breach_shed += 1
             breach = True
+        for cls, pol in self.class_policies.items():
+            if cls == "batch":
+                continue  # batch backlogs preempt/shed, never scale up
+            p95 = metrics.get(f"{self._slo_source}/{cls}/ttft_ms/p95", 0.0)
+            if p95 > pol.ttft_p95_ms:
+                self.counters.breach_class_ttft += 1
+                breach = True
         return breach
 
     # -- the control beat ----------------------------------------------

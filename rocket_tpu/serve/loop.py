@@ -52,7 +52,11 @@ from rocket_tpu.observe.ledger import expect_compile, get_goodput
 from rocket_tpu.observe.recorder import active_recorder
 from rocket_tpu.observe.trace import get_tracer
 from rocket_tpu.serve.kvstore import page_hashes
-from rocket_tpu.serve.metrics import ServeCounters, ServeLatency
+from rocket_tpu.serve.metrics import (
+    ClassLatency,
+    ServeCounters,
+    ServeLatency,
+)
 from rocket_tpu.serve.policy import DegradationPolicy
 from rocket_tpu.serve.queue import AdmissionQueue
 from rocket_tpu.serve.types import (
@@ -61,6 +65,7 @@ from rocket_tpu.serve.types import (
     Failed,
     HealthState,
     Overloaded,
+    PreemptTicket,
     Request,
 )
 from rocket_tpu.serve.watchdog import DispatchWatchdog
@@ -150,6 +155,9 @@ class ServingLoop:
         kvstore: Optional[Any] = None,
         kvpool: Optional[Any] = None,
         warmup: Optional[Any] = None,
+        class_weights: Optional[Dict[str, float]] = None,
+        class_slot_budget: Optional[Dict[str, int]] = None,
+        class_byte_budget: Optional[Dict[str, int]] = None,
     ) -> None:
         if max_batch < 1:
             raise ValueError(f"max_batch must be >= 1, got {max_batch}")
@@ -174,15 +182,25 @@ class ServingLoop:
         # Fleet identity: rides every typed result's ``meta`` and names
         # this loop's queue counters (``serve/queue/<replica>/...``).
         self.replica_id = replica_id
+        # Multi-tenant fairness knobs pass straight through to the
+        # weighted-fair admission queue (defaults match single-tenant
+        # behavior exactly: standard class, no budgets).
         self.queue = AdmissionQueue(
             queue_capacity, name=replica_id, tracer=self._tracer,
-            clock=clock,
+            clock=clock, weights=class_weights,
+            slot_budget=class_slot_budget,
+            byte_budget=class_byte_budget,
         )
         self.policy = policy if policy is not None else DegradationPolicy()
         self.watchdog = DispatchWatchdog(watchdog_timeout)
         self.counters = ServeCounters()
         self._recorder = recorder
         self.latency = ServeLatency()
+        # Multi-tenant serving: per-SLO-class TTFT/e2e histograms (the
+        # serve_slo/* attainment gauges read these) and the parked
+        # resume tickets of preempted batch rows.
+        self.slo_latency = ClassLatency()
+        self._parked: List[PreemptTicket] = []
         self._last_health = HealthState.SERVING
         self._log = logger if logger is not None else LOG
 
@@ -334,9 +352,18 @@ class ServingLoop:
 
     @property
     def load(self) -> int:
-        """Queued + in-flight request count — the least-loaded routing
-        signal a :class:`~rocket_tpu.serve.router.FleetRouter` reads."""
-        return len(self.queue) + len(self._live_rows())
+        """Queued + in-flight + parked request count — the least-loaded
+        routing signal a :class:`~rocket_tpu.serve.router.FleetRouter`
+        reads.  Parked (preempted) requests count: they still owe a
+        result, and a replica camping on parked batch work is not as
+        idle as its rows suggest."""
+        return len(self.queue) + len(self._live_rows()) + len(self._parked)
+
+    @property
+    def parked(self) -> List[PreemptTicket]:
+        """The parked resume tickets of preempted batch rows (read-only
+        view — the loop owns the re-admission order)."""
+        return list(self._parked)
 
     def submit(self, req: Request, *,
                record_rejection: bool = True) -> Optional[Overloaded]:
@@ -362,10 +389,13 @@ class ServingLoop:
                              meta=self._meta())
         else:
             self.counters.submitted += 1
+            self.counters.observe_class(req.slo_class, "submitted")
             return None
         if record_rejection:
             self.counters.submitted += 1
+            self.counters.observe_class(req.slo_class, "submitted")
             self.counters.shed_overload += 1
+            self.counters.observe_class(req.slo_class, "shed")
             self._tracer.instant("serve/overloaded", rid=req.rid,
                                  reason=rej.reason)
             self._results.append(rej)
@@ -393,6 +423,13 @@ class ServingLoop:
             if req is None:
                 break
             salvaged.append(req)
+        # Parked (preempted) requests salvage as their ORIGINAL request:
+        # the healthy replica re-serves from scratch, which is bit-equal
+        # by determinism — the ticket's cached progress dies with this
+        # replica, the exactly-once contract does not.
+        for ticket in self._parked:
+            salvaged.append(ticket.req)
+        self._parked = []
         for row, occ in self._rows.items():
             if occ is None:
                 continue
@@ -588,6 +625,7 @@ class ServingLoop:
         work ran (False = completely idle)."""
         now = self._clock()
         self._shed_hopeless(now)
+        self._preempt_batch(now)
         self._admit_pending(now)
         if not self._live_rows():
             self._flush()
@@ -607,7 +645,8 @@ class ServingLoop:
         """Drive rounds until the queue is empty and no row is live;
         returns the accumulated typed results."""
         for _ in range(max_rounds):
-            if not self.queue and not self._live_rows():
+            if not self.queue and not self._live_rows() \
+                    and not self._parked:
                 break
             self.run_round()
         else:
@@ -628,10 +667,52 @@ class ServingLoop:
         floor_s = (self._round_ms or 0.0) / 1e3
         for req in self.queue.shed_hopeless(now, floor_s):
             self.counters.shed_deadline += 1
+            self.counters.observe_class(req.slo_class, "shed")
             self._results.append(
                 DeadlineExceeded(req.rid, now, stage="queue",
                                  meta=self._meta())
             )
+
+    def _preempt_batch(self, now: float) -> None:
+        """Round-boundary batch preemption: when non-batch requests are
+        waiting and the free rows cannot seat them, evict batch-class
+        in-flight rows — export their KV pages through the normal retire
+        path (`_store_row`), park a typed resume ticket, free the row.
+        No result is emitted here: the RESUMED run owes the request's
+        single typed result, and resuming from the cached prefix is
+        bit-equal to never having been preempted (the prefix-cache
+        tier's acceptance oracle).  Host-side bookkeeping only — the
+        export/retire/admit edges already exist, no new jit traces."""
+        urgent = self.queue.urgent_waiting()
+        if urgent == 0:
+            return
+        free = sum(1 for occ in self._rows.values() if occ is None)
+        need = urgent - free
+        if need <= 0:
+            return
+        victims = [(row, occ) for row, occ in self._rows.items()
+                   if occ is not None and occ.req.slo_class == "batch"]
+        if not victims:
+            return
+        # Least progress first: the cheapest resume (fewest pages to
+        # re-import) and the least decode work at risk of cache churn.
+        n_tok_h = np.asarray(self._bat.state[1])
+        victims.sort(key=lambda pair: (int(n_tok_h[pair[0]]), pair[0]))
+        for row, occ in victims[:need]:
+            toks, nt = self._bat.row_tokens(row)
+            self._store_row(row)
+            self._bat.retire(row)
+            self._rows[row] = None
+            req = occ.req
+            produced = max(0, nt - int(req.prompt.shape[0]))
+            self._parked.append(PreemptTicket(
+                req=req, tokens=np.asarray(toks[:nt], np.int32),
+                produced=produced, preempted_at=now,
+            ))
+            self.counters.preempted += 1
+            self.counters.observe_class(req.slo_class, "preempted")
+            self._tracer.instant("serve/preempt", rid=req.rid, row=row,
+                                 n_tok=nt, produced=produced)
 
     def _admit_pending(self, now: float) -> None:
         level = self.policy.current
@@ -642,19 +723,37 @@ class ServingLoop:
             # (beam-lane serves and at-pop deadline sheds consume the
             # popped entry without occupying the row)
             while self._rows[row] is None:
-                req = self.queue.pop()
+                ticket: Optional[PreemptTicket] = None
+                if self._parked and self.queue.urgent_waiting() == 0:
+                    # parked batch resumes ahead of NEWER queued batch
+                    # (it was admitted first), but never ahead of a
+                    # waiting interactive/standard request
+                    ticket = self._parked.pop(0)
+                    req = ticket.req
+                else:
+                    req = self.queue.pop()
                 if req is None:
                     return
                 if req.deadline is not None and req.deadline <= now:
                     self.counters.shed_deadline += 1
-                    self._results.append(
-                        DeadlineExceeded(req.rid, now, stage="queue",
-                                         meta=self._meta())
-                    )
-                elif req.beam and level.beam and self._beam_fn is not None:
+                    self.counters.observe_class(req.slo_class, "shed")
+                    if ticket is not None:
+                        # it decoded before parking — ship the partial
+                        self._results.append(DeadlineExceeded(
+                            req.rid, now, tokens=ticket.tokens,
+                            n_tok=int(ticket.tokens.shape[0]),
+                            stage="decode", meta=self._meta(),
+                        ))
+                    else:
+                        self._results.append(
+                            DeadlineExceeded(req.rid, now, stage="queue",
+                                             meta=self._meta())
+                        )
+                elif ticket is None and req.beam and level.beam \
+                        and self._beam_fn is not None:
                     self._serve_beam(req, now)
                 else:
-                    self._admit_row(row, req, now)
+                    self._admit_row(row, req, now, resume=ticket)
 
     def _budget(self, req: Request, prompt_len: int) -> Tuple[int, int]:
         """(enforced new-token budget, requested new-token count)."""
@@ -665,15 +764,43 @@ class ServingLoop:
         budget = requested if cap is None else min(requested, cap)
         return max(1, budget), max(1, requested)
 
-    def _admit_row(self, row: int, req: Request, now: float) -> None:
-        prompt = req.prompt
-        budget, requested = self._budget(req, prompt.shape[0])
+    def _resume_budget(self, req: Request,
+                       ticket: PreemptTicket) -> Tuple[int, int]:
+        """Remaining budget for a resumed row: what the original request
+        asked for, minus what the preempted run already produced — so a
+        preempted-then-resumed request stops at exactly the same token
+        count as an uninterrupted one."""
+        nt = int(ticket.tokens.shape[0])
+        room = self._bat.total_len - nt
+        requested = room if req.max_new_tokens is None \
+            else min(req.max_new_tokens - int(ticket.produced), room)
+        cap = self.policy.current.max_new_cap
+        budget = requested if cap is None else min(requested, cap)
+        return max(1, budget), max(1, requested)
+
+    def _admit_row(self, row: int, req: Request, now: float, *,
+                   resume: Optional[PreemptTicket] = None) -> None:
+        # A resumed admission replays the preempted run's full token
+        # prefix as the prompt: the kvstore lookup below imports the
+        # pages the preemption exported, so only the page-unaligned tail
+        # re-prefills.  req stays the ORIGINAL request (rid, deadline,
+        # class) — the continuation is indistinguishable downstream.
+        prompt = req.prompt if resume is None else resume.tokens
+        if resume is None:
+            budget, requested = self._budget(req, prompt.shape[0])
+        else:
+            budget, requested = self._resume_budget(req, resume)
+            self.counters.resumed += 1
+            self.counters.observe_class(req.slo_class, "resumed")
+            self._tracer.instant("serve/resume", rid=req.rid, row=row,
+                                 n_tok=int(prompt.shape[0]))
         demoted = bool(req.beam)
-        if demoted:
+        if demoted and resume is None:
             self.counters.beam_demoted += 1
         submitted = getattr(req, "_submit_ts", None)
         wait_ms = (now - submitted) * 1e3 if submitted is not None else 0.0
-        self.latency.queue_wait_ms.record(wait_ms)
+        if resume is None:
+            self.latency.queue_wait_ms.record(wait_ms)
         handoff = getattr(req, "_handoff", None)
         match = None
         if handoff is None and self.kvstore is not None:
@@ -752,10 +879,12 @@ class ServingLoop:
         self.counters.admitted += 1
         self.counters.beam_served += 1
         self.counters.completed += 1
+        self.counters.observe_class(req.slo_class, "completed")
         done = self._clock()
         submitted = getattr(req, "_submit_ts", now)
         self.latency.queue_wait_ms.record((now - submitted) * 1e3)
         self.latency.e2e_ms.record((done - submitted) * 1e3)
+        self.slo_latency.record_e2e(req.slo_class, (done - submitted) * 1e3)
         self._results.append(Completed(
             req.rid, done, tokens=toks, n_tok=int(toks.shape[0]),
             via_beam=True, meta=self._meta(),
@@ -826,9 +955,14 @@ class ServingLoop:
                     # first harvested round containing this row's first
                     # generated token — the TTFT instant
                     occ.first_tok_at = now
-                    self.latency.ttft_ms.record(
-                        (now - occ.submitted_at) * 1e3
-                    )
+                    if not getattr(occ.req, "_ttft_done", False):
+                        # a resumed row's first token already happened
+                        # before preemption — never re-record its TTFT
+                        occ.req._ttft_done = True
+                        ttft_ms = (now - occ.submitted_at) * 1e3
+                        self.latency.ttft_ms.record(ttft_ms)
+                        self.slo_latency.record_ttft(
+                            occ.req.slo_class, ttft_ms)
         return True
 
     def _dump_flight(self, reason: str) -> Optional[str]:
@@ -899,6 +1033,7 @@ class ServingLoop:
                 toks, nt = self._bat.row_tokens(row)
                 self._store_row(row)
                 self.counters.completed += 1
+                self.counters.observe_class(occ.req.slo_class, "completed")
                 self._finish_latency(occ, now, nt, "serve/complete", row)
                 self._results.append(Completed(
                     occ.req.rid, now, tokens=toks, n_tok=nt,
@@ -910,6 +1045,7 @@ class ServingLoop:
                 self._store_row(row)
                 self._bat.retire(row)
                 self.counters.evicted_deadline += 1
+                self.counters.observe_class(occ.req.slo_class, "shed")
                 self._finish_latency(occ, now, n, "serve/evict", row)
                 self._results.append(DeadlineExceeded(
                     occ.req.rid, now, tokens=toks[:n], n_tok=n,
@@ -924,6 +1060,7 @@ class ServingLoop:
                 if truncated:
                     self.counters.truncated += 1
                 self.counters.completed += 1
+                self.counters.observe_class(occ.req.slo_class, "completed")
                 self._finish_latency(occ, now, nt, "serve/complete", row)
                 self._results.append(Completed(
                     occ.req.rid, now, tokens=toks, n_tok=nt,
@@ -966,7 +1103,9 @@ class ServingLoop:
                         event: str, row: int) -> None:
         """Terminal accounting for one row: e2e always; TPOT when at
         least two generated tokens bracket an interval."""
-        self.latency.e2e_ms.record((now - occ.submitted_at) * 1e3)
+        e2e_ms = (now - occ.submitted_at) * 1e3
+        self.latency.e2e_ms.record(e2e_ms)
+        self.slo_latency.record_e2e(occ.req.slo_class, e2e_ms)
         produced = n_tok - occ.prompt_len
         if occ.first_tok_at is not None and produced > 1:
             self.latency.tpot_ms.record(
@@ -977,7 +1116,12 @@ class ServingLoop:
 
     def _update_policy(self) -> None:
         before = self.policy.level
-        level = self.policy.update(self.queue.depth_frac, self._round_ms)
+        # The ladder sees only the NON-BATCH backlog: a deep batch queue
+        # is answered by batch preemption and per-class budgets, never
+        # by degrading interactive quality (shed batch before degrading
+        # interactive — the multi-tenant ordering contract).
+        level = self.policy.update(self.queue.depth_frac_urgent,
+                                   self._round_ms)
         if level != before:
             self._log.info(
                 "serve: degradation %d -> %d (%s)", before, level,

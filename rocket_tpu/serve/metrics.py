@@ -9,17 +9,39 @@ serving-side faults land in the same pane as the training-side
 
 from __future__ import annotations
 
-from typing import Dict
+from typing import Any, Dict, Optional
 
 from rocket_tpu.observe.trace import Histogram
+from rocket_tpu.serve.types import SLO_CLASSES
+
+# Per-class TTFT targets (ms) the SLO-attainment gauges measure against
+# when no explicit targets are given: interactive is tight, standard
+# relaxed, batch effectively throughput-only.
+DEFAULT_SLO_TARGETS: Dict[str, float] = {
+    "interactive": 500.0,
+    "standard": 2000.0,
+    "batch": 30000.0,
+}
 
 
 class ServeCounters:
     """Plain integer counters plus the round-latency EMA.  ``snapshot``
     returns a flat float dict ready for ``TrackerBackend.log_scalars``.
+
+    ``class_counts`` splits the multi-tenant events per SLO class; the
+    snapshot flattens them as ``class/<cls>/<event>`` so they ride the
+    same ``serve/*`` flush (and the same Prometheus export) as the flat
+    counters.
     """
 
+    _CLASS_EVENTS = ("submitted", "completed", "shed", "preempted",
+                     "resumed")
+
     def __init__(self) -> None:
+        self.class_counts: Dict[str, Dict[str, int]] = {
+            cls: {ev: 0 for ev in self._CLASS_EVENTS}
+            for cls in SLO_CLASSES
+        }
         self.submitted = 0
         self.admitted = 0
         self.prefilled_admits = 0   # admissions that imported a KVHandoff
@@ -38,6 +60,10 @@ class ServeCounters:
         self.shed_overload = 0      # bounded-queue / draining rejections
         self.shed_deadline = 0      # shed before prefill (stage='queue')
         self.evicted_deadline = 0   # evicted mid-decode (stage='decode')
+        self.preempted = 0          # batch rows evicted-to-kvstore for
+                                    # higher-class admissions
+        self.resumed = 0            # parked tickets re-admitted from
+                                    # their cached prefix
         self.truncated = 0          # degradation max-new cap cutoffs
         self.failed = 0             # watchdog / step-error row failures
         self.watchdog_trips = 0
@@ -60,8 +86,21 @@ class ServeCounters:
         self.degrade_level = level
         self.degrade_peak = max(self.degrade_peak, level)
 
+    def observe_class(self, slo_class: str, event: str, n: int = 1) -> None:
+        """Bump one per-class event counter (unknown classes are counted
+        under ``standard`` rather than raising — counters must never
+        take the serve path down)."""
+        per = self.class_counts.get(slo_class,
+                                    self.class_counts["standard"])
+        per[event] = per.get(event, 0) + n
+
     def snapshot(self) -> Dict[str, float]:
-        return {
+        out = {
+            f"class/{cls}/{ev}": float(n)
+            for cls, events in self.class_counts.items()
+            for ev, n in events.items()
+        }
+        out.update({
             "submitted": float(self.submitted),
             "admitted": float(self.admitted),
             "prefilled_admits": float(self.prefilled_admits),
@@ -80,6 +119,8 @@ class ServeCounters:
             "shed_overload": float(self.shed_overload),
             "shed_deadline": float(self.shed_deadline),
             "evicted_deadline": float(self.evicted_deadline),
+            "preempted": float(self.preempted),
+            "resumed": float(self.resumed),
             "truncated": float(self.truncated),
             "failed": float(self.failed),
             "watchdog_trips": float(self.watchdog_trips),
@@ -89,7 +130,8 @@ class ServeCounters:
             "degrade_level": float(self.degrade_level),
             "degrade_peak": float(self.degrade_peak),
             "round_ms_ema": float(self.round_ms_ema),
-        }
+        })
+        return out
 
 
 class ServeLatency:
@@ -127,12 +169,101 @@ class ServeLatency:
             getattr(self, name).merge(getattr(other, name))
 
 
+class ClassLatency:
+    """Per-SLO-class TTFT and e2e histograms — the raw material for the
+    SLO-attainment gauges.
+
+    Merge rule (documented in docs/observability.md): fleet aggregation
+    merges the per-class SAMPLE windows and recomputes attainment over
+    the merged window — attainment fractions are never averaged across
+    replicas (a quiet replica's perfect 1.0 would mask a loaded one's
+    0.6)."""
+
+    def __init__(self, capacity: int = 2048) -> None:
+        self.ttft_ms: Dict[str, Histogram] = {
+            cls: Histogram(capacity) for cls in SLO_CLASSES}
+        self.e2e_ms: Dict[str, Histogram] = {
+            cls: Histogram(capacity) for cls in SLO_CLASSES}
+
+    def record_ttft(self, slo_class: str, ms: float) -> None:
+        self.ttft_ms.get(slo_class, self.ttft_ms["standard"]).record(ms)
+
+    def record_e2e(self, slo_class: str, ms: float) -> None:
+        self.e2e_ms.get(slo_class, self.e2e_ms["standard"]).record(ms)
+
+    def attainment(self, targets: Optional[Dict[str, float]] = None
+                   ) -> Dict[str, float]:
+        """Fraction of the TTFT window at or under each class's target
+        (classes with no samples yet export nothing — a fake 1.0 would
+        read as a healthy SLO)."""
+        targets = targets or DEFAULT_SLO_TARGETS
+        out: Dict[str, float] = {}
+        for cls, hist in self.ttft_ms.items():
+            samples = list(hist._samples)
+            target = targets.get(cls)
+            if not samples or target is None:
+                continue
+            ok = sum(1 for s in samples if s <= target)
+            out[cls] = ok / len(samples)
+        return out
+
+    def summary(self) -> Dict[str, float]:
+        """Flatten to ``<cls>/ttft_ms/p50...`` / ``<cls>/e2e_ms/...``."""
+        out: Dict[str, float] = {}
+        for cls in SLO_CLASSES:
+            out.update(self.ttft_ms[cls].summary(f"{cls}/ttft_ms"))
+            out.update(self.e2e_ms[cls].summary(f"{cls}/e2e_ms"))
+        return out
+
+    def merge(self, other: "ClassLatency") -> None:
+        for cls in SLO_CLASSES:
+            self.ttft_ms[cls].merge(other.ttft_ms[cls])
+            self.e2e_ms[cls].merge(other.e2e_ms[cls])
+
+
+def register_slo_source(provider: Any, name: str = "serve_slo", *,
+                        targets: Optional[Dict[str, float]] = None) -> None:
+    """Hang per-class SLO gauges on the Prometheus export registry.
+
+    ``provider`` is anything exposing ``slo_latency`` — a
+    :class:`~rocket_tpu.serve.ServingLoop` attribute or a
+    :class:`~rocket_tpu.serve.FleetRouter` method returning the merged
+    fleet view.  Exports, per class: the TTFT/e2e percentiles
+    (``<cls>/ttft_ms/p95`` ...) and the attainment gauge
+    ``<cls>/ttft_attainment`` — the fraction of the recent TTFT window
+    meeting the class target, computed AFTER merging sample windows
+    across replicas (never an average of per-replica fractions)."""
+    from rocket_tpu.observe import export
+
+    def _snapshot() -> Dict[str, float]:
+        lat = provider.slo_latency
+        if callable(lat):
+            lat = lat()
+        out = lat.summary()
+        for cls, frac in lat.attainment(targets).items():
+            out[f"{cls}/ttft_attainment"] = float(frac)
+        counters = getattr(provider, "counters", None)
+        if counters is not None and hasattr(counters, "class_counts"):
+            for cls, events in counters.class_counts.items():
+                for ev, n in events.items():
+                    out[f"{cls}/{ev}"] = float(n)
+        return out
+
+    export.register_source(name, _snapshot)
+
+
 class FleetCounters:
     """Router-level counters — the fleet analogue of
     :class:`ServeCounters`; per-replica counters stay on each replica's
     own loop, these count only decisions the ROUTER made."""
 
     def __init__(self) -> None:
+        # Per-class routing outcomes (multi-tenant serving): flattened
+        # into the snapshot as ``class/<cls>/routed`` etc., so a batch
+        # flood's fleet-level sheds are attributable to batch.
+        self.class_counts: Dict[str, Dict[str, int]] = {
+            cls: {"routed": 0, "shed_saturated": 0} for cls in SLO_CLASSES
+        }
         self.submitted = 0          # requests handed to the router
         self.routed = 0             # accepted by some replica
         self.handoffs = 0           # prefill lane -> decode lane transfers
@@ -148,8 +279,18 @@ class FleetCounters:
         self.replicas_added = 0     # autoscaler spawns joined to the fleet
         self.replicas_retired = 0   # replicas drained out of the fleet
 
+    def observe_class(self, slo_class: str, event: str) -> None:
+        per = self.class_counts.get(slo_class,
+                                    self.class_counts["standard"])
+        per[event] = per.get(event, 0) + 1
+
     def snapshot(self) -> Dict[str, float]:
-        return {
+        out = {
+            f"class/{cls}/{ev}": float(n)
+            for cls, events in self.class_counts.items()
+            for ev, n in events.items()
+        }
+        out.update({
             "submitted": float(self.submitted),
             "routed": float(self.routed),
             "handoffs": float(self.handoffs),
@@ -164,4 +305,5 @@ class FleetCounters:
             "pool_handoffs": float(self.pool_handoffs),
             "replicas_added": float(self.replicas_added),
             "replicas_retired": float(self.replicas_retired),
-        }
+        })
+        return out

@@ -43,7 +43,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from rocket_tpu.observe.trace import Histogram
 from rocket_tpu.serve import wire
-from rocket_tpu.serve.metrics import ServeLatency
+from rocket_tpu.serve.metrics import ClassLatency, ServeLatency
 from rocket_tpu.serve.types import HealthState, ReplicaId, Request
 from rocket_tpu.utils.framing import FrameListener
 
@@ -94,6 +94,7 @@ class ProcReplica:
         self._load = 0
         self._health = HealthState.SERVING
         self.latency = ServeLatency()
+        self.slo_latency = ClassLatency()
         self.counters: Dict[str, float] = {}
         self.spawns = 0
         # Warm-start telemetry (ISSUE 15): the READY payload the worker
@@ -149,6 +150,7 @@ class ProcReplica:
         self._load = 0
         self._health = HealthState.SERVING
         self.latency = ServeLatency()
+        self.slo_latency = ClassLatency()
         self.ready_info = dict(payload or {})
         self.compile_ms = float(self.ready_info.get("compile_ms", 0.0))
         self.spawn_ms.record((self._clock() - t0) * 1e3)
@@ -289,6 +291,9 @@ class ProcReplica:
                 # snapshot-REPLACE (not merge): the worker ships its own
                 # cumulative histograms each step
                 self.latency = latency
+            slo = reply.get("slo_latency")
+            if slo is not None:
+                self.slo_latency = slo
             self.counters = reply.get("counters", self.counters)
         hashes = reply.get("kv_hashes")
         if hashes and self._prefix_index is not None:
@@ -440,6 +445,7 @@ class ProcReplica:
         self._load = 0
         self._health = HealthState.SERVING
         self.latency = ServeLatency()
+        self.slo_latency = ClassLatency()
         self.ready_info = dict(donor.ready_info)
         self.compile_ms = float(self.ready_info.get("compile_ms", 0.0))
         self._spawn_t0 = self._clock()

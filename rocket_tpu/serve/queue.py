@@ -1,4 +1,4 @@
-"""Bounded admission queue with explicit load shedding.
+"""Bounded weighted-fair admission queue with explicit load shedding.
 
 The failure mode this prevents: an unbounded request queue under a
 traffic burst grows until every request in it is doomed — memory climbs,
@@ -8,77 +8,197 @@ IMMEDIATE typed rejection at submit (the caller can retry elsewhere),
 plus deadline-aware shedding at the head — an entry that cannot
 possibly produce its first tokens before its deadline is dropped BEFORE
 it spends a prefill dispatch.
+
+Multi-tenant serving adds FAIRNESS on top: one queue per SLO class
+(:data:`~rocket_tpu.serve.types.SLO_CLASSES`), popped by stride
+scheduling — each pop advances the chosen class's virtual pass time by
+``1/weight``, and the next pop takes the non-empty class with the
+smallest pass (ties break toward the higher-priority class).  A batch
+flood therefore cannot starve interactive arrivals: batch only drains
+in the troughs its weight entitles it to.  Per-class slot and byte
+budgets bound how much of the shared capacity any one class can camp
+on, and ordering WITHIN a class is deadline-aware (earliest deadline
+first; deadline-less entries keep FIFO order behind them).
 """
 
 from __future__ import annotations
 
 import time
 from collections import deque
-from typing import List, Optional
+from typing import Dict, List, Optional
 
-from rocket_tpu.serve.types import Request
+from rocket_tpu.serve.types import SLO_CLASSES, Request
+
+# Default stride weights: interactive pops ~8x as often as batch when
+# both classes are backlogged.  Priority ORDER (tie-breaks, preemption)
+# comes from SLO_CLASSES; weights only shape the steady-state share.
+DEFAULT_CLASS_WEIGHTS: Dict[str, float] = {
+    "interactive": 8.0,
+    "standard": 4.0,
+    "batch": 1.0,
+}
 
 
 class AdmissionQueue:
-    """FIFO of :class:`Request` with a hard ``capacity``.
+    """Per-class queues of :class:`Request` under one hard ``capacity``.
 
     The queue itself is dumb on purpose — it accepts or refuses, and it
     sheds hopeless entries when asked; the :class:`ServingLoop` owns the
     typed results and the counters, so every shed is accounted for
     exactly once.
 
+    ``weights`` maps SLO class -> stride weight (missing classes get
+    weight 1); ``slot_budget`` / ``byte_budget`` optionally cap one
+    class's queued entry count / total queued prompt bytes below the
+    shared ``capacity`` — a batch flood fills its budget and then
+    refuses, leaving headroom for interactive arrivals.
+
     With a ``tracer`` attached the queue emits its depth and the age of
     its oldest entry as ``serve/queue/<name>/depth`` /
-    ``serve/queue/<name>/oldest_age_s`` counters on every change, so
+    ``serve/queue/<name>/oldest_age_s`` counters on every change, plus
+    a per-class ``serve/queue/<name>/<class>/depth`` split, so
     per-replica queue pressure shows up in flight-recorder dumps
     alongside the loop-level round stats.
     """
 
     def __init__(self, capacity: int, *, name: Optional[str] = None,
-                 tracer=None, clock=time.monotonic) -> None:
+                 tracer=None, clock=time.monotonic,
+                 weights: Optional[Dict[str, float]] = None,
+                 slot_budget: Optional[Dict[str, int]] = None,
+                 byte_budget: Optional[Dict[str, int]] = None) -> None:
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
         self.name = name or "loop"
         self._tracer = tracer
         self._clock = clock
-        self._items: deque = deque()
+        self.weights = dict(DEFAULT_CLASS_WEIGHTS)
+        if weights:
+            for cls, w in weights.items():
+                if cls not in SLO_CLASSES:
+                    raise ValueError(f"unknown SLO class {cls!r}")
+                if w <= 0:
+                    raise ValueError(f"weight for {cls!r} must be > 0")
+                self.weights[cls] = float(w)
+        self.slot_budget = dict(slot_budget or {})
+        self.byte_budget = dict(byte_budget or {})
+        self._queues: Dict[str, deque] = {c: deque() for c in SLO_CLASSES}
+        self._bytes: Dict[str, int] = {c: 0 for c in SLO_CLASSES}
+        # Stride scheduling state: the class with the smallest pass pops
+        # next; each pop advances its pass by 1/weight.
+        self._pass: Dict[str, float] = {c: 0.0 for c in SLO_CLASSES}
+        self._seq = 0  # FIFO tie-break within a class
 
     def __len__(self) -> int:
-        return len(self._items)
+        return sum(len(q) for q in self._queues.values())
+
+    def depth(self, slo_class: Optional[str] = None) -> int:
+        """Queued entry count, for one class or in total."""
+        if slo_class is None:
+            return len(self)
+        return len(self._queues[slo_class])
+
+    def bytes_queued(self, slo_class: str) -> int:
+        return self._bytes[slo_class]
 
     def _observe(self) -> None:
         if self._tracer is None:
             return
         prefix = f"serve/queue/{self.name}"
-        self._tracer.counter(f"{prefix}/depth", len(self._items))
+        self._tracer.counter(f"{prefix}/depth", len(self))
+        for cls in SLO_CLASSES:
+            self._tracer.counter(f"{prefix}/{cls}/depth",
+                                 len(self._queues[cls]))
         age = 0.0
-        if self._items:
-            enq = getattr(self._items[0], "_enq_ts", None)
-            if enq is not None:
-                age = max(0.0, self._clock() - enq)
+        oldest = None
+        for q in self._queues.values():
+            for req in q:
+                enq = getattr(req, "_enq_ts", None)
+                if enq is not None and (oldest is None or enq < oldest):
+                    oldest = enq
+        if oldest is not None:
+            age = max(0.0, self._clock() - oldest)
         self._tracer.counter(f"{prefix}/oldest_age_s", age)
 
     @property
     def depth_frac(self) -> float:
         """Queue depth as a fraction of capacity — the degradation
         ladder's primary load signal."""
-        return len(self._items) / self.capacity
+        return len(self) / self.capacity
+
+    @property
+    def depth_frac_urgent(self) -> float:
+        """Non-batch depth as a fraction of capacity.  The serving loop
+        feeds THIS to the degradation ladder: a deep batch backlog is
+        answered by shedding/preempting batch, never by degrading
+        interactive quality."""
+        urgent = sum(len(self._queues[c]) for c in SLO_CLASSES
+                     if c != "batch")
+        return urgent / self.capacity
+
+    def urgent_waiting(self) -> int:
+        """Queued non-batch entries — the preemption trigger count."""
+        return sum(len(self._queues[c]) for c in SLO_CLASSES
+                   if c != "batch")
 
     def offer(self, request: Request) -> bool:
-        """Enqueue; ``False`` when full (the caller sheds with a typed
+        """Enqueue; ``False`` when full — globally, or past the
+        request's class slot/byte budget (the caller sheds with a typed
         :class:`~rocket_tpu.serve.types.Overloaded`)."""
-        if len(self._items) >= self.capacity:
+        if len(self) >= self.capacity:
+            return False
+        cls = request.slo_class
+        q = self._queues[cls]
+        slots = self.slot_budget.get(cls)
+        if slots is not None and len(q) >= slots:
+            return False
+        nbytes = int(request.prompt.nbytes)
+        cap_bytes = self.byte_budget.get(cls)
+        if cap_bytes is not None and self._bytes[cls] + nbytes > cap_bytes:
             return False
         request._enq_ts = self._clock()
-        self._items.append(request)
+        self._seq += 1
+        request._seq = self._seq
+        q.append(request)
+        self._bytes[cls] += nbytes
         self._observe()
         return True
 
+    def _next_class(self) -> Optional[str]:
+        best = None
+        for cls in SLO_CLASSES:  # order = priority tie-break
+            if not self._queues[cls]:
+                continue
+            if best is None or self._pass[cls] < self._pass[best]:
+                best = cls
+        return best
+
     def pop(self) -> Optional[Request]:
-        if not self._items:
+        """Weighted-fair pop: stride-select the class, then earliest
+        deadline first within it (deadline-less entries keep FIFO order
+        behind every deadline)."""
+        cls = self._next_class()
+        if cls is None:
             return None
-        req = self._items.popleft()
+        q = self._queues[cls]
+        best_i = 0
+        best_key = None
+        for i, req in enumerate(q):
+            key = (req.deadline if req.deadline is not None
+                   else float("inf"), getattr(req, "_seq", 0))
+            if best_key is None or key < best_key:
+                best_key = key
+                best_i = i
+        q.rotate(-best_i)
+        req = q.popleft()
+        q.rotate(best_i)
+        self._bytes[cls] -= int(req.prompt.nbytes)
+        self._pass[cls] += 1.0 / self.weights.get(cls, 1.0)
+        if not any(self._queues.values()):
+            # idle reset: pass times only matter relative to each other
+            # while a backlog exists; zeroing avoids unbounded growth
+            for c in SLO_CLASSES:
+                self._pass[c] = 0.0
         self._observe()
         return req
 
@@ -87,16 +207,20 @@ class AdmissionQueue:
         possibly be met: ``deadline - now < floor_s``, where ``floor_s``
         is the loop's estimate of the minimum time to first tokens (one
         observed decode round).  Entries without deadlines are never
-        shed here."""
-        kept: deque = deque()
+        shed here.  Order within each class is preserved; the returned
+        list carries each shed request's ``slo_class`` for the caller's
+        per-class accounting."""
         shed: List[Request] = []
-        while self._items:
-            req = self._items.popleft()
-            if req.deadline is not None and req.deadline - now < floor_s:
-                shed.append(req)
-            else:
-                kept.append(req)
-        self._items = kept
+        for cls in SLO_CLASSES:
+            kept: deque = deque()
+            while self._queues[cls]:
+                req = self._queues[cls].popleft()
+                if req.deadline is not None and req.deadline - now < floor_s:
+                    shed.append(req)
+                    self._bytes[cls] -= int(req.prompt.nbytes)
+                else:
+                    kept.append(req)
+            self._queues[cls] = kept
         if shed:
             self._observe()
         return shed

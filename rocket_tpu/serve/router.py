@@ -41,7 +41,11 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from rocket_tpu.observe.recorder import active_recorder
 from rocket_tpu.serve.fleet import PrefillReplica, Replica
-from rocket_tpu.serve.metrics import FleetCounters, ServeLatency
+from rocket_tpu.serve.metrics import (
+    ClassLatency,
+    FleetCounters,
+    ServeLatency,
+)
 from rocket_tpu.serve.types import (
     DeadlineExceeded,
     HealthState,
@@ -183,8 +187,10 @@ class FleetRouter:
                 self._instant("fleet/route", rid=req.rid, lane="decode",
                               replica=rep.replica_id, affine=affine)
                 self.counters.routed += 1
+                self.counters.observe_class(req.slo_class, "routed")
                 return None
         self.counters.shed_saturated += 1
+        self.counters.observe_class(req.slo_class, "shed_saturated")
         self._instant("fleet/saturated", rid=req.rid)
         rej = Overloaded(req.rid, self._clock(), reason="fleet saturated",
                          meta={"replica": None, "level": None})
@@ -469,6 +475,23 @@ class FleetRouter:
                     agg.merge(rep.latency)
                 except Exception:
                     pass
+        return agg
+
+    def slo_latency(self) -> ClassLatency:
+        """Fleet-wide per-SLO-class latency view, merged the same way as
+        :meth:`latency` — sample windows merge, so attainment gauges are
+        computed over the merged window, never averaged per replica."""
+        agg = ClassLatency()
+        for rep in list(self.replicas) + list(self._retiring):
+            for source in ("loop", None):
+                try:
+                    holder = getattr(rep, source) if source else rep
+                    slo = holder.slo_latency
+                    if slo is not None:
+                        agg.merge(slo)
+                    break
+                except Exception:
+                    continue
         return agg
 
     def snapshot(self) -> Dict[str, float]:

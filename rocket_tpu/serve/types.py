@@ -34,6 +34,14 @@ import numpy as np
 # reaching into router internals.
 ReplicaId = str
 
+# SLO classes, in strict priority order (multi-tenant serving).  The
+# order is load-bearing: the weighted-fair queue breaks ties toward the
+# earlier class, the serving loop preempts ``batch`` rows to make room
+# for the earlier classes, and the degradation ladder is fed only the
+# non-batch backlog — batch pressure sheds/preempts batch, it never
+# degrades interactive quality.
+SLO_CLASSES = ("interactive", "standard", "batch")
+
 
 class HealthState(enum.Enum):
     """Readiness of the serving loop — the state machine the demo (and a
@@ -61,6 +69,13 @@ class Request:
     the replica whose prefix-cache store holds their KV pages (falling
     back to least-loaded, and dropping the stamp when that replica is
     healed).
+
+    ``tenant`` names who submitted the request (an opaque accounting
+    key); ``slo_class`` is one of :data:`SLO_CLASSES` and decides how
+    the request competes for capacity: weighted-fair admission,
+    per-class budgets and shed accounting, and — for ``batch`` — cheap
+    round-boundary preemption (evict-to-kvstore, resume later,
+    bit-equal).  Both cross the RPC wire.
     """
 
     rid: Any
@@ -69,8 +84,15 @@ class Request:
     max_new_tokens: Optional[int] = None
     beam: bool = False
     session: Optional[Any] = None
+    tenant: Optional[str] = None
+    slo_class: str = "standard"
 
     def __post_init__(self) -> None:
+        if self.slo_class not in SLO_CLASSES:
+            raise ValueError(
+                f"request {self.rid!r}: slo_class must be one of "
+                f"{SLO_CLASSES}, got {self.slo_class!r}"
+            )
         prompt = np.asarray(self.prompt, np.int32)
         if prompt.ndim == 2 and prompt.shape[0] == 1:
             prompt = prompt[0]
@@ -127,6 +149,26 @@ class DeadlineExceeded(Result):
     tokens: Optional[np.ndarray] = None
     n_tok: int = 0
     stage: str = "queue"  # 'queue' = shed before prefill; 'decode' = evicted
+
+
+@dataclasses.dataclass
+class PreemptTicket:
+    """A preempted batch-class row, parked for later resumption.
+
+    NOT a result — the preempted request still owes its caller exactly
+    one typed result, which the RESUMED run emits.  ``tokens`` is the
+    full token prefix decoded so far (prompt + generated, 1-D int32):
+    the resume admission replays it as the prompt, importing whatever
+    prefix pages the preemption exported into the kvstore, so the
+    continuation is bit-equal to an uninterrupted run at the cost of
+    (at most) the un-paged tail's prefill.  ``produced`` counts
+    generated tokens relative to the ORIGINAL prompt — the resume's
+    remaining ``max_new_tokens`` budget subtracts it."""
+
+    req: "Request"
+    tokens: np.ndarray
+    produced: int
+    preempted_at: float
 
 
 @dataclasses.dataclass(frozen=True)

@@ -41,7 +41,11 @@ from rocket_tpu.utils.framing import FramedSocket
 # :class:`ProtocolMismatch` naming the remedy, instead of un-pickling
 # garbage three RPCs into the run.
 #   1: versioned handshake; NEW_WEIGHTS / ROLLBACK_WEIGHTS swap RPCs.
-PROTOCOL_VERSION = 1
+#   2: multi-tenant serving — Request.tenant / Request.slo_class ride
+#      the SUBMIT frame (a v1 peer would silently drop the class and
+#      serve batch floods at interactive priority, so this is a
+#      compatibility break, not an additive field).
+PROTOCOL_VERSION = 2
 
 
 class ProtocolMismatch(RuntimeError):
@@ -207,6 +211,8 @@ def pack_request(req: Request, *,
         "max_new_tokens": req.max_new_tokens,
         "beam": bool(req.beam),
         "session": req.session,
+        "tenant": req.tenant,
+        "slo_class": req.slo_class,
     }
     handoff = getattr(req, "_handoff", None)
     if handoff is not None:
@@ -224,6 +230,8 @@ def unpack_request(wire: Dict[str, Any], *,
         max_new_tokens=wire.get("max_new_tokens"),
         beam=bool(wire.get("beam", False)),
         session=wire.get("session"),
+        tenant=wire.get("tenant"),
+        slo_class=wire.get("slo_class", "standard"),
     )
     handoff = wire.get("handoff")
     if handoff is not None:

@@ -144,6 +144,7 @@ def serve(fs: FramedSocket, loop: Any, *,
                     "load": int(loop.load),
                     "health": loop.health.value,
                     "latency": loop.latency,
+                    "slo_latency": getattr(loop, "slo_latency", None),
                     "counters": loop.counters.snapshot(),
                 }
                 if kvstore is not None:
@@ -195,6 +196,7 @@ def serve(fs: FramedSocket, loop: Any, *,
                 wire.send_msg(fs, wire.REPLY, {
                     "counters": loop.counters.snapshot(),
                     "latency": loop.latency,
+                    "slo_latency": getattr(loop, "slo_latency", None),
                     "ledger": get_retrace_ledger().snapshot(),
                     "goodput": get_goodput().snapshot(),
                     "compile_cache": _cc.snapshot(),

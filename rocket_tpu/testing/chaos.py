@@ -16,9 +16,13 @@ Three fault families, matching how TPU training actually dies:
 - **serving faults**: :class:`SlowSource` delays scheduled fetches
   (latency, not failure — the retry path must NOT fire),
   :class:`StuckStepInjector` wedges scheduled ``ContinuousBatcher.step``
-  calls (driving the serve watchdog's trip-and-rebuild path), and
+  calls (driving the serve watchdog's trip-and-rebuild path),
   :func:`bursty_arrivals` builds the overload arrival schedules the
-  admission-control tests replay;
+  admission-control tests replay (with an optional tenant-skew knob
+  labelling each arrival by deterministic weighted interleave), and
+  :class:`BatchFloodInjector` drowns a serving target in counter-indexed
+  batch-class requests (driving the WFQ + preemption path: interactive
+  SLO must hold while batch fills the troughs);
 - **fleet faults**: :class:`ReplicaKillInjector` raises
   :class:`ReplicaKilled` out of scheduled ``ServingLoop.run_round``
   calls (the in-process stand-in for a replica process dying — drives
@@ -439,12 +443,22 @@ def bursty_arrivals(
     gap_s: float,
     spread_s: float = 0.0,
     start_s: float = 0.0,
-) -> List[float]:
+    tenants: Optional[List] = None,
+) -> List:
     """Arrival offsets (seconds, ascending) for ``n`` requests in bursts
     of ``burst``, one burst every ``gap_s``; within a burst arrivals are
     spaced evenly across ``spread_s`` (0 = simultaneous).  Deterministic
     by construction — the overload tests replay the same storm every
-    run."""
+    run.
+
+    The tenant-skew knob: ``tenants`` is an optional list of
+    ``(name, share)`` pairs; when given, each arrival is labelled with a
+    tenant via deterministic stride interleaving over the shares
+    (exactly the weighted-fair pop order, so a 9:1 skew really delivers
+    9 of every 10 arrivals to the heavy tenant — no sampling noise),
+    and the return becomes a list of ``(offset_s, tenant_name)`` tuples.
+    Left as ``None``, the return is the plain ``List[float]`` every
+    pre-existing overload test replays."""
     if n < 1 or burst < 1:
         raise ValueError(f"n and burst must be >= 1, got {n}, {burst}")
     out: List[float] = []
@@ -452,7 +466,83 @@ def bursty_arrivals(
         b, j = divmod(i, burst)
         within = 0.0 if burst == 1 else spread_s * j / burst
         out.append(start_s + b * gap_s + within)
-    return out
+    if tenants is None:
+        return out
+    names = [str(name) for name, _ in tenants]
+    shares = [float(share) for _, share in tenants]
+    if not names or any(s <= 0 for s in shares):
+        raise ValueError(f"tenants need positive shares, got {tenants!r}")
+    passes = [0.0] * len(names)
+    labels: List[str] = []
+    for _ in range(n):
+        k = min(range(len(names)), key=lambda j: (passes[j], j))
+        passes[k] += 1.0 / shares[k]
+        labels.append(names[k])
+    return list(zip(out, labels))
+
+
+class BatchFloodInjector:
+    """Drown a serving target in batch-class work, deterministically.
+
+    ``tick()`` is the injector's clock — the chaos driver calls it once
+    per pump beat, and on the ticks in ``flood_on`` (``None`` = every
+    tick) the injector submits ``per_tick`` batch-class requests to the
+    target's ``submit`` (a ServingLoop or a FleetRouter — anything with
+    the submit surface).  Prompts are counter-indexed (token ``i`` of
+    request ``k`` is ``(k + i) % vocab``), never random, so the flood
+    replays exactly — the multi-tenant acceptance test compares an
+    interactive trace WITH this flood against the batch-free baseline,
+    and the comparison only means something if the flood is identical
+    every run.  Rejections are expected (that is the admission queue's
+    per-class byte budget doing its job) and counted, never raised.
+    """
+
+    def __init__(self, target: Any, *, per_tick: int = 1,
+                 flood_on: Optional[Iterable[int]] = None,
+                 prompt_len: int = 8, max_new_tokens: int = 4,
+                 vocab: int = 64, tenant: str = "flood",
+                 rid_prefix: str = "flood") -> None:
+        from rocket_tpu.serve.types import Request
+
+        self._request_cls = Request
+        self._target = target
+        self._per_tick = int(per_tick)
+        self._flood_on = None if flood_on is None \
+            else set(int(i) for i in flood_on)
+        self._prompt_len = int(prompt_len)
+        self._max_new = int(max_new_tokens)
+        self._vocab = int(vocab)
+        self._tenant = tenant
+        self._rid_prefix = rid_prefix
+        self.ticks = 0      # tick() calls seen
+        self.submitted = 0  # requests the target accepted
+        self.rejected = 0   # typed rejections (queue said no)
+        self.rids: List[str] = []  # accepted rids, submission order
+
+    def tick(self) -> int:
+        """Advance the chaos clock; returns how many batch requests the
+        target accepted on this tick."""
+        pos = self.ticks
+        self.ticks += 1
+        if self._flood_on is not None and pos not in self._flood_on:
+            return 0
+        accepted = 0
+        for j in range(self._per_tick):
+            k = pos * self._per_tick + j
+            prompt = ((np.arange(self._prompt_len) + k)
+                      % self._vocab).astype(np.int32)
+            req = self._request_cls(
+                rid=f"{self._rid_prefix}-{k}", prompt=prompt,
+                max_new_tokens=self._max_new, tenant=self._tenant,
+                slo_class="batch")
+            rej = self._target.submit(req)
+            if rej is None:
+                accepted += 1
+                self.submitted += 1
+                self.rids.append(req.rid)
+            else:
+                self.rejected += 1
+        return accepted
 
 
 def corrupt_snapshot(path: str, mode: str = "uncommit") -> None:

@@ -72,6 +72,9 @@ def build_tiny_loop(
     kv_cache_int8: Optional[bool] = None,
     watchdog_timeout: Optional[float] = None,
     warmup: Optional[Any] = None,
+    class_weights: Optional[dict] = None,
+    class_slot_budget: Optional[dict] = None,
+    class_byte_budget: Optional[dict] = None,
 ) -> Any:
     """The WorkerSpec builder: a fresh ServingLoop over the tiny pair.
 
@@ -86,7 +89,10 @@ def build_tiny_loop(
     degrades to pool-less serving.  ``kv_cache_int8`` forces the int8
     KV-cache layout (pages then travel int8 + rank-4 f32 scales).
     ``warmup`` (``"auto"`` / a WarmupPlan wire dict) arms the AOT
-    warm-start tier — plain data, so it rides WorkerSpec kwargs."""
+    warm-start tier — plain data, so it rides WorkerSpec kwargs.
+    ``class_weights`` / ``class_slot_budget`` / ``class_byte_budget``
+    tune weighted-fair admission per SLO class (plain dicts, so they
+    ride WorkerSpec kwargs too); defaults keep single-tenant behavior."""
     from rocket_tpu.models.generate import ContinuousBatcher
     from rocket_tpu.serve.kvstore import PrefixKVStore
     from rocket_tpu.serve.loop import ServingLoop
@@ -126,6 +132,9 @@ def build_tiny_loop(
         kvstore=kvstore,
         kvpool=kvpool,
         warmup=warmup,
+        class_weights=class_weights,
+        class_slot_budget=class_slot_budget,
+        class_byte_budget=class_byte_budget,
     )
 
 

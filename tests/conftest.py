@@ -107,6 +107,14 @@ def pytest_configure(config):
     )
     config.addinivalue_line(
         "markers",
+        "tenants: multi-tenant serving tests (rocket_tpu.serve "
+        "queue/loop/loadgen — SLO classes, weighted-fair admission, "
+        "batch preemption with bit-equal resume, trace-replay harness; "
+        "see docs/reliability.md \"Multi-tenant serving\"; spawn-heavy "
+        "cases live in tests/test_tenants_proc.py on the heavy tail)",
+    )
+    config.addinivalue_line(
+        "markers",
         "warmstart: warm-start tier tests (rocket_tpu.tune "
         "compile_cache/warmup — persistent compile cache, AOT "
         "executable reuse, pre-warmed/standby spawns; see "
@@ -133,13 +141,14 @@ _HEAVY_TAIL = (
     "test_procfleet.py",
     "test_kvpool_proc.py",
     "test_trainserve.py",
+    "test_tenants_proc.py",
 )
 
 
 # The newest spawn-heavy file runs LAST of all: when the timed tier-1
 # budget truncates, the cut lands on the newest coverage first and the
 # long-standing seed suite still runs to completion.
-_TAIL_END = ("test_trainserve.py",)
+_TAIL_END = ("test_trainserve.py", "test_tenants_proc.py")
 
 
 def pytest_collection_modifyitems(config, items):
@@ -148,7 +157,9 @@ def pytest_collection_modifyitems(config, items):
     def tier(item):
         name = item.fspath.basename
         if name in _TAIL_END:
-            return 2
+            # _TAIL_END is newest-last: truncation cuts newest coverage
+            # first regardless of alphabetical collection order.
+            return 2 + _TAIL_END.index(name)
         if name in _HEAVY_TAIL or item.get_closest_marker("warmstart"):
             return 1
         return 0

@@ -1067,6 +1067,63 @@ class TestSwapGuard:
         )
 
 
+@pytest.mark.tenants
+class TestTenantGuard:
+    """Batch preemption guard (ISSUE 18 acceptance): \"cheap\" means the
+    park-and-resume machinery is pure host work — exporting a victim's
+    KV pages, parking the ticket, and re-admitting it later must reuse
+    the admit/decode shapes the loop already compiled.  A steady-state
+    preempt/resume cycle adds ZERO jit traces to the decode round."""
+
+    def test_preempt_resume_zero_retrace(self, devices):
+        import numpy as np
+
+        from rocket_tpu.models.generate import _spec_round
+        from rocket_tpu.serve.types import Request
+        from rocket_tpu.testing import workers as tw
+
+        loop = tw.build_tiny_loop(max_batch=2, kvstore_page_tokens=3)
+        rng = np.random.default_rng(23)
+        prompts = rng.integers(1, tw.VOCAB,
+                               size=(8, tw.P)).astype(np.int32)
+
+        def cycle(tag, i0):
+            # a batch row decoding next to a standard row; two
+            # interactive arrivals evict the batch row at the round
+            # boundary, and run-to-idle parks AND resumes it
+            assert loop.submit(Request(rid=f"{tag}-bat",
+                                       prompt=prompts[i0],
+                                       slo_class="batch")) is None
+            assert loop.submit(Request(rid=f"{tag}-std",
+                                       prompt=prompts[i0 + 1])) is None
+            loop.run_round()
+            for j in (2, 3):
+                assert loop.submit(Request(rid=f"{tag}-i{j}",
+                                           prompt=prompts[i0 + j],
+                                           slo_class="interactive"
+                                           )) is None
+            res = loop.run_until_idle()
+            assert sorted(r.rid for r in res) == sorted(
+                f"{tag}-{s}" for s in ("bat", "std", "i2", "i3"))
+
+        try:
+            cycle("warm", 0)        # compiles every shape involved
+            assert loop.counters.preempted >= 1
+            assert loop.counters.resumed >= 1
+            traces = _spec_round._cache_size()
+            pre, res = loop.counters.preempted, loop.counters.resumed
+            cycle("run", 4)         # steady state: same shapes again
+            assert loop.counters.preempted > pre
+            assert loop.counters.resumed > res
+            assert _spec_round._cache_size() == traces, (
+                "preempt/resume retraced — parking or re-admitting a "
+                "batch row changed a jit signature (shape/dtype leak "
+                "in the KV export/import path)"
+            )
+        finally:
+            loop.close()
+
+
 class TestZeroGuard:
     """ZeRO-1 guard (ISSUE 12): the sharding plan's per-device optimizer
     bytes must drop >= (N-1)/N on an N-way data axis, and turning

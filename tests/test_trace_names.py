@@ -90,7 +90,9 @@ def test_library_emits_trace_events():
     # the lint is only meaningful if the scan actually sees the emitters
     names = {name for _p, _l, name in _all_sites()}
     assert {"serve/submit", "ledger/compile",
-            "quant/int8_matmul/fallback"} <= names
+            "quant/int8_matmul/fallback",
+            # multi-tenant serving: preemption lifecycle markers
+            "serve/preempt", "serve/resume"} <= names
 
 
 # -- jax.jit chokepoint lint (ISSUE 15 satellite) ----------------------------
