@@ -1291,10 +1291,27 @@ def run_tenants(args, model, draft, params, draft_params, arrivals,
     replay_trace(trace, warm_loop, speed=1000.0)
     warm_loop.close()
 
+    crit = bool(getattr(args, "critpath", False))
+    tracer = None
+    if crit:
+        # the serve loop records into the process tracer; arm it so the
+        # flood pass yields a per-class critical-path decomposition
+        from rocket_tpu.observe import trace as _obs_trace
+
+        tracer = _obs_trace.arm(1 << 15)
+
     base_p95, base_rep, _, _ = one_pass("pass 1 — mixed trace, "
                                         "no flood", flood=False)
+    if tracer is not None:
+        tracer.clear()  # attribute pass 2 only (same rids both passes)
     flood_p95, flood_rep, snap, lat = one_pass(
         "pass 2 — same trace + batch flood every round", flood=True)
+    if tracer is not None:
+        print("  [tenants] critical path per class (flood pass — where "
+              "each class's time went):")
+        for line in flood_rep.critpath_summary(
+                tracer.events()).splitlines():
+            print(f"  [tenants]   {line}")
     ratio = flood_p95 / max(base_p95, 1e-9)
     print(f"  [tenants] interactive ttft p95: {base_p95:.0f}ms clean vs "
           f"{flood_p95:.0f}ms under flood ({ratio:.2f}x — the "
@@ -1385,6 +1402,11 @@ def main():
                              "spans, a p50/p95 TTFT/TPOT table, and a "
                              "flight-recorder dump path at exit "
                              "(implies --mode robust)")
+    parser.add_argument("--critpath", action="store_true",
+                        help="[tenants] arm the tracer during the flood "
+                             "pass and print the per-class critical-path "
+                             "breakdown (queue_wait / prefill / decode / "
+                             "preempt_parked ... — docs/observability.md)")
     parser.add_argument("--metrics-port", type=int, default=-1,
                         help="arm the goodput/retrace ledgers and serve "
                              "Prometheus text on this port's /metrics "

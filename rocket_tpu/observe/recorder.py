@@ -75,9 +75,14 @@ class FlightRecorder:
     def tracer(self) -> Tracer:
         return self._tracer
 
-    def dump(self, reason: str = "manual") -> str:
+    def dump(self, reason: str = "manual",
+             extra_meta: Optional[dict] = None) -> str:
         """Write the current ring as ``trace.json`` + ``tail.txt``;
-        returns the dump directory path."""
+        returns the dump directory path.  ``extra_meta`` merges into the
+        dump's metadata — the serve loop passes its in-flight request
+        inventory ({rid, cls, trace_id}) so a dump is navigable by
+        request (tail-sampling: those contexts are promoted by the
+        caller even when head-sampling skipped them)."""
         with self._lock:
             self._seq += 1
             name = (
@@ -89,6 +94,8 @@ class FlightRecorder:
             doc_path = os.path.join(path, "trace.json")
             doc = self._tracer.to_chrome()
             doc["metadata"]["dump_reason"] = reason
+            if extra_meta:
+                doc["metadata"].update(extra_meta)
             with open(doc_path, "w") as f:
                 json.dump(doc, f, default=str)
             with open(os.path.join(path, "tail.txt"), "w") as f:

@@ -1,0 +1,229 @@
+"""Timeline stitching — merge supervisor and worker trace dumps into ONE
+clock-aligned, Perfetto-loadable request timeline.
+
+:func:`rocket_tpu.observe.trace.merge_traces` aligns multi-HOST dumps at
+the launch barrier's wall-clock anchor; this module solves the finer
+fleet problem: a supervisor and its worker PROCESSES share a machine but
+not a ``perf_counter`` origin, and wall-clock anchors (millisecond-ish)
+are too coarse to order a supervisor handoff against the worker admit it
+caused.  The supervisor instead estimates each connection's clock offset
+from request/reply stamps (:class:`~rocket_tpu.observe.trace.
+OffsetEstimator` over the ``mono_ns`` field wire v3 adds to STEP/PONG
+replies, error bounded by rtt/2) and writes ``clock_offsets.json`` next
+to the dumps (:func:`rocket_tpu.serve.procfleet.write_offsets`).
+
+Stitching then rebases every worker event onto the supervisor clock::
+
+    ts_sup = ts_worker - offset        # offset = worker - supervisor
+
+Each dump keeps its own Perfetto lane (``pid`` = dump index, named via
+``process_name`` metadata events from the dump's role/replica/pid meta),
+flow arrows (``ph: s/t/f``) connect one request's hops across lanes, and
+:func:`request_timelines` groups the stitched events back out by rid for
+programmatic checks (the acceptance test sums a request's segments
+against the supervisor-measured e2e).
+
+Dumps with no matching offset entry fall back to wall-anchor alignment
+(same move as ``merge_traces``) and are flagged in the stitched
+metadata — degraded, never dropped.
+
+CLI::
+
+    python -m rocket_tpu.observe.timeline <trace_dir> [-o out.json]
+        [--offsets clock_offsets.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+from rocket_tpu.observe.trace import _iter_trace_files
+
+OFFSETS_FILE = "clock_offsets.json"
+
+
+def load_offsets(path: str) -> Dict[str, Dict[str, float]]:
+    """Read a ``clock_offsets.json`` (replica id -> {offset_us, rtt_us,
+    samples, pid}); missing/garbled file is just no offsets."""
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    return doc if isinstance(doc, dict) else {}
+
+
+def _match_offset(meta: Dict[str, Any],
+                  offsets: Dict[str, Dict[str, float]]
+                  ) -> Optional[float]:
+    """Offset (us, worker − supervisor) for a dump, matched by the
+    replica id the worker stamped in its tracer meta, then by pid."""
+    replica = str(meta.get("replica", ""))
+    if replica and replica in offsets:
+        return float(offsets[replica].get("offset_us", 0.0))
+    pid = meta.get("pid")
+    if pid is not None:
+        for entry in offsets.values():
+            if int(entry.get("pid", -1)) == int(pid):
+                return float(entry.get("offset_us", 0.0))
+    return None
+
+
+def _load_docs(trace_dir: str) -> List[Tuple[str, Dict[str, Any]]]:
+    docs = []
+    for path in _iter_trace_files(trace_dir):
+        if os.path.basename(path) == OFFSETS_FILE:
+            continue
+        try:
+            with open(path) as f:
+                doc = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(doc, dict) and isinstance(doc.get("traceEvents"), list):
+            docs.append((path, doc))
+    return docs
+
+
+def stitch_timeline(
+    trace_dir: str,
+    offsets: Optional[Dict[str, Dict[str, float]]] = None,
+    out_path: Optional[str] = None,
+) -> Dict[str, Any]:
+    """Stitch every Chrome-trace dump under ``trace_dir`` onto the
+    supervisor's clock; returns (and optionally writes) the merged doc.
+
+    The supervisor dump (tracer meta ``role`` absent or not ``worker``)
+    defines the reference clock and shifts by 0.  A worker dump shifts
+    by ``-offset_us`` from its matched offset entry; with no match it
+    falls back to wall-anchor alignment against the supervisor dump.
+    """
+    docs = _load_docs(trace_dir)
+    if not docs:
+        raise FileNotFoundError(
+            f"no Chrome-trace JSON dumps found under {trace_dir!r}")
+    if offsets is None:
+        offsets = load_offsets(os.path.join(trace_dir, OFFSETS_FILE))
+
+    sup_meta: Dict[str, Any] = {}
+    for _path, doc in docs:
+        meta = doc.get("metadata", {})
+        if meta.get("role", "supervisor") != "worker":
+            sup_meta = meta
+            break
+
+    merged: List[Dict[str, Any]] = []
+    lanes: List[Dict[str, Any]] = []
+    unaligned: List[str] = []
+    for lane, (path, doc) in enumerate(docs):
+        meta = doc.get("metadata", {})
+        role = str(meta.get("role", "supervisor"))
+        if role != "worker":
+            shift_us = 0.0
+            aligned = "reference"
+        else:
+            off = _match_offset(meta, offsets)
+            if off is not None:
+                shift_us = -off
+                aligned = "offset"
+            else:
+                # wall-anchor fallback: coarse (ms-level skew) but
+                # better than raw clocks from different processes
+                wall = meta.get("anchor_wall_s")
+                perf = meta.get("anchor_perf_us")
+                sup_wall = sup_meta.get("anchor_wall_s")
+                sup_perf = sup_meta.get("anchor_perf_us")
+                if None not in (wall, perf, sup_wall, sup_perf):
+                    shift_us = (wall - sup_wall) * 1e6 - perf + sup_perf
+                    aligned = "wall_anchor"
+                else:
+                    shift_us = 0.0
+                    aligned = "none"
+                    unaligned.append(os.path.basename(path))
+        label = str(meta.get("replica") or role)
+        lanes.append({
+            "file": os.path.basename(path), "role": role,
+            "label": label, "shift_us": shift_us, "aligned": aligned,
+        })
+        # one Perfetto lane group per dump, named for its process
+        merged.append({
+            "name": "process_name", "ph": "M", "pid": lane, "tid": 0,
+            "args": {"name": f"{label} ({role})"},
+        })
+        for ev in doc["traceEvents"]:
+            ev = dict(ev)
+            ev["pid"] = lane
+            ev["ts"] = float(ev.get("ts", 0.0)) + shift_us
+            merged.append(ev)
+    merged.sort(key=lambda ev: (ev.get("ph") != "M", ev.get("ts", 0.0)))
+    out: Dict[str, Any] = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "stitched_from": len(docs),
+            "lanes": lanes,
+            "unaligned_files": unaligned,
+        },
+    }
+    if out_path is not None:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(out, f, default=str)
+    return out
+
+
+def request_timelines(doc: Dict[str, Any]) -> Dict[str, List[Dict[str, Any]]]:
+    """Group a stitched doc's events by request id (from ``args.rid``,
+    or parsed off ``args.trace_id``), each list in stitched-time order —
+    the programmatic view of one request's journey."""
+    out: Dict[str, List[Dict[str, Any]]] = {}
+    for ev in doc.get("traceEvents", []):
+        args = ev.get("args", {}) or {}
+        rid = args.get("rid")
+        if rid is None:
+            tid = args.get("trace_id")
+            if isinstance(tid, str) and "-" in tid:
+                rid = tid.split("-", 1)[1]
+        if rid is None:
+            continue
+        out.setdefault(str(rid), []).append(ev)
+    for events in out.values():
+        events.sort(key=lambda ev: ev.get("ts", 0.0))
+    return out
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m rocket_tpu.observe.timeline",
+        description="Stitch supervisor + worker trace dumps onto the "
+        "supervisor clock using per-connection offset estimates.",
+    )
+    parser.add_argument("trace_dir", help="directory holding the "
+                        "supervisor dump, worker-*.json dumps, and "
+                        "(optionally) clock_offsets.json")
+    parser.add_argument("-o", "--out", default=None,
+                        help="output path (default: "
+                        "<trace_dir>/timeline.json)")
+    parser.add_argument("--offsets", default=None,
+                        help="clock offsets file (default: "
+                        "<trace_dir>/clock_offsets.json)")
+    args = parser.parse_args(argv)
+    offsets = load_offsets(args.offsets) if args.offsets else None
+    out_path = args.out or os.path.join(args.trace_dir, "timeline.json")
+    doc = stitch_timeline(args.trace_dir, offsets, out_path)
+    meta = doc["metadata"]
+    print(f"stitched {meta['stitched_from']} dump(s) -> {out_path} "
+          f"({len(doc['traceEvents'])} events)")
+    for lane in meta["lanes"]:
+        print(f"  lane {lane['label']:<12} role={lane['role']:<10} "
+              f"shift={lane['shift_us']:+.1f}us via {lane['aligned']}")
+    if meta["unaligned_files"]:
+        print("warning: unaligned (raw-clock) dumps: "
+              + ", ".join(meta["unaligned_files"]))
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via subprocess
+    raise SystemExit(_main())

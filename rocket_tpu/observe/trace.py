@@ -37,21 +37,25 @@ per-host dump so the anchors coincide on the merged timeline
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import threading
 import time
+import zlib
 from collections import deque
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 # Event layout (plain tuples — cheapest thing CPython can append):
 #   (kind, name, ts_ns, dur_ns, tid, fields)
 # kind: 'X' completed span, 'C' counter sample, 'I' instant / log event,
-#       'H' health transition.  ts_ns is perf_counter_ns at event start.
+#       'H' health transition, 'F' flow (cross-process request arrow).
+# ts_ns is perf_counter_ns at event start.
 SPAN = "X"
 COUNTER = "C"
 INSTANT = "I"
 HEALTH = "H"
+FLOW = "F"
 
 
 def _process_index() -> int:
@@ -131,6 +135,11 @@ class Tracer:
         # (wall seconds, perf_counter_ns) stamped at the launch barrier —
         # the cross-host alignment point for merge_traces.
         self.anchor: Optional[Tuple[float, int]] = None
+        # Free-form labels exported in the dump metadata — a fleet worker
+        # stamps {"role", "replica", "pid"} here so the timeline stitcher
+        # can match its ring to the supervisor's per-connection clock
+        # offset without guessing from filenames.
+        self.meta: Dict[str, Any] = {}
 
     # -- recording (hot path) -------------------------------------------
 
@@ -165,6 +174,23 @@ class Tracer:
         fields["state"] = state
         self._buf.append(
             (HEALTH, name, time.perf_counter_ns(), 0,
+             threading.get_ident(), fields)
+        )
+
+    def flow(self, name: str, phase: str, flow_id: int,
+             cat: str = "request", **fields: Any) -> None:
+        """Flow event tying cross-process segments of one request into a
+        single Chrome-trace arrow chain.  ``phase`` is the Chrome flow
+        phase: ``"s"`` start, ``"t"`` step, ``"f"`` finish.  ``flow_id``
+        must be identical on every segment of the chain (derived from the
+        request's trace_id)."""
+        if not self.enabled:
+            return
+        fields["ph"] = phase
+        fields["id"] = int(flow_id)
+        fields["cat"] = cat
+        self._buf.append(
+            (FLOW, name, time.perf_counter_ns(), 0,
              threading.get_ident(), fields)
         )
 
@@ -214,6 +240,16 @@ class Tracer:
                 ev["s"] = "p"  # process-scoped marker line
                 ev["cat"] = "health"
                 ev["args"] = fields
+            elif kind == FLOW:
+                args = dict(fields)
+                ev["ph"] = args.pop("ph", "t")
+                ev["id"] = args.pop("id", 0)
+                ev["cat"] = args.pop("cat", "request")
+                if ev["ph"] == "f":
+                    # bind the finish to the enclosing slice, the
+                    # chrome://tracing requirement for terminal arrows
+                    ev["bp"] = "e"
+                ev["args"] = args
             else:  # INSTANT
                 ev["ph"] = "i"
                 ev["s"] = "t"
@@ -224,6 +260,7 @@ class Tracer:
             "capacity": self.capacity,
             "clock": "perf_counter_ns/1e3 (us)",
         }
+        meta.update(self.meta)
         if self.anchor is not None:
             meta["anchor_wall_s"] = self.anchor[0]
             meta["anchor_perf_us"] = self.anchor[1] / 1e3
@@ -295,6 +332,143 @@ def counter(name: str, value: float = 1, **fields: Any) -> None:
     The convenience for library code that wants one line, not a
     ``get_tracer()`` dance — e.g. ``ops.quant``'s fallback telemetry."""
     _GLOBAL.counter(name, value, **fields)
+
+
+# -- distributed request tracing --------------------------------------------
+#
+# A TraceContext is stamped on a Request at submit and crosses every
+# process boundary the request does (wire v3 SUBMIT/STEP/PAGES/
+# NEW_WEIGHTS frames, KVPoolClient fetches) so supervisor, router,
+# prefill, pool, and decode-worker events stitch into one timeline.
+# Sampling is HEAD-sampled by a seeded hash of the rid — deterministic
+# across processes, so every hop makes the same keep/drop decision
+# without coordination — and promoted to sampled=True on bad outcomes
+# (shed, deadline, preempt, watchdog trip, heal): the requests worth
+# debugging are always fully traced.
+
+_SAMPLING = {"rate": 1.0, "seed": 0}
+
+
+def set_sampling(rate: float = 1.0, seed: int = 0) -> None:
+    """Configure head-sampling for :meth:`TraceContext.make`: ``rate`` in
+    [0, 1] is the fraction of requests whose flow events are emitted;
+    ``seed`` varies which deterministic subset is picked."""
+    _SAMPLING["rate"] = min(1.0, max(0.0, float(rate)))
+    _SAMPLING["seed"] = int(seed)
+
+
+def get_sampling() -> Tuple[float, int]:
+    return float(_SAMPLING["rate"]), int(_SAMPLING["seed"])
+
+
+@dataclasses.dataclass
+class TraceContext:
+    """Per-request distributed-tracing context (trace_id + parent span id
+    + sampled flag).  Plain data — crosses the wire as a 3-tuple."""
+
+    trace_id: str
+    parent: str = ""
+    sampled: bool = True
+
+    @classmethod
+    def make(cls, rid: Any, *, rate: Optional[float] = None,
+             seed: Optional[int] = None) -> "TraceContext":
+        """Deterministic context for ``rid``: the crc32 of ``seed:rid``
+        decides sampling, so any process recomputing it (or a mid-upgrade
+        v2 peer re-stamping a ctx-less frame) agrees on keep/drop."""
+        if rate is None:
+            rate = float(_SAMPLING["rate"])
+        if seed is None:
+            seed = int(_SAMPLING["seed"])
+        h = zlib.crc32(f"{seed}:{rid}".encode())
+        sampled = (h % 10_000) < rate * 10_000
+        return cls(trace_id=f"{h:08x}-{rid}", parent="", sampled=sampled)
+
+    @property
+    def flow_id(self) -> int:
+        """Stable integer id for Chrome flow events on this request."""
+        return zlib.crc32(self.trace_id.encode())
+
+    def child(self, parent: str) -> "TraceContext":
+        return TraceContext(self.trace_id, parent, self.sampled)
+
+    def to_wire(self) -> Tuple[str, str, bool]:
+        return (self.trace_id, self.parent, bool(self.sampled))
+
+    @classmethod
+    def from_wire(cls, wire: Any) -> Optional["TraceContext"]:
+        """Tolerant decode: a missing/garbled ctx (a v2 peer) is ``None``,
+        never an exception — mid-upgrade fleets degrade to unsampled."""
+        if not (isinstance(wire, (tuple, list)) and len(wire) == 3):
+            return None
+        trace_id, parent, sampled = wire
+        if not isinstance(trace_id, str):
+            return None
+        return cls(trace_id, str(parent or ""), bool(sampled))
+
+
+def instant(name: str, **fields: Any) -> None:
+    """Instant-event convenience on the global tracer (no-op unless
+    armed) — for code that records one marker, not a whole tracer."""
+    _GLOBAL.instant(name, **fields)
+
+
+def flow(name: str, phase: str, flow_id: int,
+         cat: str = "request", **fields: Any) -> None:
+    """Flow-event convenience on the global tracer (no-op unless armed)."""
+    _GLOBAL.flow(name, phase, flow_id, cat, **fields)
+
+
+class OffsetEstimator:
+    """Per-connection clock-offset estimate from request/reply stamps.
+
+    Each sample is ``(t0, tw, t1)``: supervisor ``perf_counter_ns``
+    before send, the worker's ``perf_counter_ns`` stamped in the reply,
+    and the supervisor's after receive.  Assuming symmetric transit, the
+    worker clock read ``tw`` corresponds to supervisor instant
+    ``(t0 + t1) / 2``, so ``offset = tw - (t0 + t1) / 2`` satisfies
+    ``worker_clock ≈ supervisor_clock + offset`` — the NTP discipline,
+    and the same shift-to-common-origin move :func:`merge_traces` makes
+    with wall-clock anchors.  The estimate keeps the last ``window``
+    samples and answers from the MINIMUM-RTT one: queueing delay only
+    ever inflates RTT, so the tightest exchange bounds the error by
+    rtt/2 and a refreshed window tracks slow drift between pings."""
+
+    def __init__(self, window: int = 8) -> None:
+        self._samples: deque = deque(maxlen=int(window))
+
+    def add(self, t0_ns: int, tw_ns: int, t1_ns: int) -> None:
+        rtt = int(t1_ns) - int(t0_ns)
+        if rtt < 0:  # clock went backwards — unusable sample
+            return
+        offset = int(tw_ns) - (int(t0_ns) + int(t1_ns)) // 2
+        self._samples.append((rtt, offset))
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    @property
+    def offset_ns(self) -> Optional[int]:
+        """worker_clock − supervisor_clock, from the min-RTT sample;
+        ``None`` until the first sample lands."""
+        if not self._samples:
+            return None
+        return min(self._samples)[1]
+
+    @property
+    def rtt_ns(self) -> Optional[int]:
+        if not self._samples:
+            return None
+        return min(self._samples)[0]
+
+    def snapshot(self) -> Dict[str, float]:
+        """Flat floats for dumps/export: offset_us / rtt_us / samples."""
+        out: Dict[str, float] = {"samples": float(len(self._samples))}
+        if self._samples:
+            rtt, offset = min(self._samples)
+            out["offset_us"] = offset / 1e3
+            out["rtt_us"] = rtt / 1e3
+        return out
 
 
 # -- latency histograms -----------------------------------------------------

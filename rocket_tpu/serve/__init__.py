@@ -48,7 +48,11 @@ from rocket_tpu.serve.policy import (
     DegradationLevel,
     DegradationPolicy,
 )
-from rocket_tpu.serve.procfleet import ProcReplica
+from rocket_tpu.serve.procfleet import (
+    ProcReplica,
+    collect_offsets,
+    write_offsets,
+)
 from rocket_tpu.serve.queue import DEFAULT_CLASS_WEIGHTS, AdmissionQueue
 from rocket_tpu.serve.router import FleetRouter
 from rocket_tpu.serve.types import (
@@ -114,5 +118,7 @@ __all__ = [
     "register_slo_source",
     "register_swap_source",
     "replay_trace",
+    "collect_offsets",
     "synth_trace",
+    "write_offsets",
 ]

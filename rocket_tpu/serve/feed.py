@@ -88,6 +88,11 @@ class WeightFeed:
         version, path = latest
         if self._rejected.get(path) == version:
             return 0
+        # one trace context per publication: every replica's NEW_WEIGHTS
+        # offer (and the worker-side serve/new_weights instant) shares a
+        # trace id, so a fleet-wide rollout stitches into one timeline
+        from rocket_tpu.observe.trace import TraceContext
+        ctx = TraceContext.make(f"weights-v{version}")
         swapped = 0
         for replica in list(self._replicas):
             current = int(getattr(replica, "weights_version", -1))
@@ -96,10 +101,16 @@ class WeightFeed:
             self.pushes += 1
             try:
                 ok = replica.swap_weights(path, version,
-                                          deep_verify=self._deep_verify)
+                                          deep_verify=self._deep_verify,
+                                          ctx=ctx)
             except TypeError:
-                # a replica surface without the keyword (older builds)
-                ok = replica.swap_weights(path, version)
+                # a replica surface without the keywords (older builds
+                # or in-process replicas that swap directly)
+                try:
+                    ok = replica.swap_weights(
+                        path, version, deep_verify=self._deep_verify)
+                except TypeError:
+                    ok = replica.swap_weights(path, version)
             if ok:
                 swapped += 1
                 self.swaps += 1

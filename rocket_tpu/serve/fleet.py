@@ -441,6 +441,9 @@ class PrefillReplica:
                     self._pending.appendleft(req)  # salvageable
                 self._dead = f"prefill failed: {exc!r}"
                 return False
+            # handoff-latency stamp: the router's fleet/handoff and
+            # fleet/pool_handoff instants report wire_ms relative to this
+            req._prefill_done_ns = time.perf_counter_ns()
             if self._kvpool is not None:
                 nbytes = self._push_pages(handoff)
                 if nbytes is not None:

@@ -49,6 +49,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import jax
 
 from rocket_tpu.models.generate import KVPage
+from rocket_tpu.observe import trace
+from rocket_tpu.observe.trace import TraceContext
 from rocket_tpu.serve import wire
 from rocket_tpu.serve.kvstore import PrefixKVStore
 from rocket_tpu.utils.framing import (
@@ -229,12 +231,19 @@ class KVPagePool:
             wire.send_msg(fs, wire.REPLY, {"stored": stored})
         elif kind == wire.FETCH_PAGES:
             hashes = payload["hashes"]
+            # v3 wire: the requesting replica's TraceContext rides the
+            # payload, so the pool's side of a sampled fetch lands in
+            # the POOL HOST's ring under the request's trace_id.
+            ctx = TraceContext.from_wire(payload.get("ctx"))
             with self._lock:
                 self.fetches += 1
             match = self._store.match_hashes(hashes)
             if match is None:
                 with self._lock:
                     self.nacks += 1
+                if ctx is not None and ctx.sampled:
+                    trace.instant("pool/fetch", trace_id=ctx.trace_id,
+                                  hit=False, hashes=len(hashes))
                 wire.send_msg(fs, wire.PAGE_NACK, None)
                 return
             try:
@@ -244,6 +253,12 @@ class KVPagePool:
             with self._lock:
                 self.fetch_hits += 1
                 self.bytes_out += len(blob)
+            if ctx is not None and ctx.sampled:
+                trace.instant("pool/fetch", trace_id=ctx.trace_id,
+                              hit=True, pages=len(match.pages),
+                              nbytes=len(blob))
+                trace.flow("serve/request", "t", ctx.flow_id,
+                           hop="pool")
             wire.send_msg(fs, wire.PAGES, blob)
         elif kind == wire.PING:
             wire.send_msg(fs, wire.PONG, None)
@@ -327,19 +342,27 @@ class KVPoolClient:
         wire.send_msg(self._fs, kind, payload)
         return wire.recv_msg(self._fs, self._timeout)
 
-    def fetch(self, hashes: List[bytes]) -> Optional[List[KVPage]]:
+    def fetch(self, hashes: List[bytes],
+              ctx: Optional[TraceContext] = None
+              ) -> Optional[List[KVPage]]:
         """Longest pooled prefix of ``hashes`` as owned host pages, or
         ``None`` (NACK / error / dead pool).  Wall time is charged to
-        the ``serve/kvstore/wire`` goodput bucket."""
+        the ``serve/kvstore/wire`` goodput bucket.  ``ctx`` (the
+        admitting request's TraceContext) crosses in the FETCH_PAGES
+        payload so the pool host tags its side of the fetch with the
+        same trace_id."""
         if self._dead or not hashes:
             return None
         from rocket_tpu.observe.ledger import get_goodput
+        payload_out: Dict[str, Any] = {"hashes": list(hashes)}
+        if ctx is not None:
+            payload_out["ctx"] = ctx.to_wire()
         with self._lock:
             self.fetches += 1
             try:
                 with get_goodput().timed(WIRE_BUCKET):
                     kind, payload = self._rpc(
-                        wire.FETCH_PAGES, {"hashes": list(hashes)})
+                        wire.FETCH_PAGES, payload_out)
             except (ConnectionError, OSError, EOFError, ValueError):
                 _log.warning("kvpool: fetch failed; disabling client",
                              exc_info=True)

@@ -213,6 +213,10 @@ class ReplayReport:
     generated_tokens: int = 0
     per_class: Dict[str, Dict[str, float]] = dataclasses.field(
         default_factory=dict)
+    # rid -> trace_id for every submitted request (distributed tracing:
+    # the handle that finds a replayed request in a stitched timeline or
+    # a flight dump's in-flight inventory)
+    trace_ids: Dict[str, str] = dataclasses.field(default_factory=dict)
 
     @property
     def goodput_tok_s(self) -> float:
@@ -225,6 +229,19 @@ class ReplayReport:
     def attainment(self, slo_class: str) -> float:
         return float(self.per_class.get(slo_class, {}).get(
             "attainment", 0.0))
+
+    def critpath_summary(self, events: Sequence[tuple]) -> str:
+        """Per-class critical-path table over ``events`` (a tracer ring
+        snapshot from the replay), restricted to this replay's requests —
+        the ``--critpath`` output of the serve demo."""
+        from rocket_tpu.observe.critpath import (
+            aggregate, analyze_events, format_table,
+        )
+        mine = {str(rid) for rid in self.trace_ids}
+        paths = [p for p in analyze_events(list(events))
+                 if not mine or str(p.rid) in mine]
+        table = format_table(aggregate(paths))
+        return table if table else "(no traced terminal requests)\n"
 
 
 def _slo_view(target: Any) -> Optional[Any]:
@@ -301,6 +318,9 @@ def replay_trace(events: Sequence[TraceEvent], target: Any, *,
             # do), so the return value is advisory only — absorbing it
             # here would double-count and falsely trip exactly-once.
             target.submit(req)
+            ctx = getattr(req, "_ctx", None)
+            if ctx is not None:
+                report.trace_ids[str(req.rid)] = ctx.trace_id
             pending[req.rid] = ev
             fired = True
         _absorb(drain() or [])

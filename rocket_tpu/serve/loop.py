@@ -50,7 +50,7 @@ import numpy as np
 from rocket_tpu.models.generate import export_kv_row
 from rocket_tpu.observe.ledger import expect_compile, get_goodput
 from rocket_tpu.observe.recorder import active_recorder
-from rocket_tpu.observe.trace import get_tracer
+from rocket_tpu.observe.trace import TraceContext, get_tracer
 from rocket_tpu.serve.kvstore import page_hashes
 from rocket_tpu.serve.metrics import (
     ClassLatency,
@@ -350,6 +350,22 @@ class ServingLoop:
         result so fleet tests can assert routing without internals."""
         return {"replica": self.replica_id, "level": self.policy.level}
 
+    @staticmethod
+    def _promote(req: Request) -> None:
+        """Tail-sample a bad outcome: force the request's trace context
+        sampled, so the flow chain survives even when head-sampling
+        skipped it.  The requests worth debugging are always traced."""
+        ctx = getattr(req, "_ctx", None)
+        if ctx is not None:
+            ctx.sampled = True
+
+    def _flow(self, req: Request, phase: str, **fields: Any) -> None:
+        """Emit a request-flow event when the request is sampled."""
+        ctx = getattr(req, "_ctx", None)
+        if ctx is not None and ctx.sampled:
+            self._tracer.flow("serve/request", phase, ctx.flow_id,
+                              rid=req.rid, **fields)
+
     @property
     def load(self) -> int:
         """Queued + in-flight + parked request count — the least-loaded
@@ -380,7 +396,21 @@ class ServingLoop:
         # clock, so fake-clock tests stay deterministic).  Request is a
         # plain dataclass — the private stamp rides the object.
         req._submit_ts = self._clock()
-        self._tracer.instant("serve/submit", rid=req.rid)
+        # Distributed tracing: a request arriving without a context (the
+        # local entry point) gets a fresh head-sampled one; a wire-borne
+        # request keeps the one the submitter stamped.
+        ctx = getattr(req, "_ctx", None)
+        if ctx is None:
+            ctx = TraceContext.make(req.rid)
+            req._ctx = ctx
+        self._tracer.instant("serve/submit", rid=req.rid,
+                             cls=req.slo_class, trace_id=ctx.trace_id)
+        if ctx.sampled:
+            # the flow chain starts at the first hop (empty parent) and
+            # steps through every later process the request enters
+            self._tracer.flow("serve/request",
+                              "s" if not ctx.parent else "t",
+                              ctx.flow_id, rid=req.rid)
         if self._draining:
             rej = Overloaded(req.rid, self._clock(), reason="draining",
                              meta=self._meta())
@@ -392,6 +422,7 @@ class ServingLoop:
             self.counters.observe_class(req.slo_class, "submitted")
             return None
         if record_rejection:
+            ctx.sampled = True  # bad outcome: promote past head-sampling
             self.counters.submitted += 1
             self.counters.observe_class(req.slo_class, "submitted")
             self.counters.shed_overload += 1
@@ -668,6 +699,8 @@ class ServingLoop:
         for req in self.queue.shed_hopeless(now, floor_s):
             self.counters.shed_deadline += 1
             self.counters.observe_class(req.slo_class, "shed")
+            self._promote(req)
+            self._flow(req, "f", outcome="shed_deadline")
             self._results.append(
                 DeadlineExceeded(req.rid, now, stage="queue",
                                  meta=self._meta())
@@ -711,6 +744,7 @@ class ServingLoop:
             ))
             self.counters.preempted += 1
             self.counters.observe_class(req.slo_class, "preempted")
+            self._promote(req)
             self._tracer.instant("serve/preempt", rid=req.rid, row=row,
                                  n_tok=nt, produced=produced)
 
@@ -737,6 +771,8 @@ class ServingLoop:
                 if req.deadline is not None and req.deadline <= now:
                     self.counters.shed_deadline += 1
                     self.counters.observe_class(req.slo_class, "shed")
+                    self._promote(req)
+                    self._flow(req, "f", outcome="shed_deadline")
                     if ticket is not None:
                         # it decoded before parking — ship the partial
                         self._results.append(DeadlineExceeded(
@@ -806,7 +842,8 @@ class ServingLoop:
         if handoff is None and self.kvstore is not None:
             match = self.kvstore.lookup(prompt)
             if match is None and self.kvpool is not None:
-                match = self._pool_fetch(prompt)
+                match = self._pool_fetch(prompt, req)
+        self._flow(req, "t", hop="admit")
         # The admit IS the row's prefill (the batcher rebuilds the row's
         # cache from the prompt) — one span covers admission + prefill.
         # A handed-off request skips the prefill: its KV rows import as
@@ -841,28 +878,34 @@ class ServingLoop:
                                requested, demoted, submitted_at=submitted)
         self.counters.admitted += 1
 
-    def _pool_fetch(self, prompt: np.ndarray) -> Optional[Any]:
+    def _pool_fetch(self, prompt: np.ndarray,
+                    req: Optional[Request] = None) -> Optional[Any]:
         """Local admit-miss → consult the fleet page pool.  Fetched
         pages land in the LOCAL store first (put_pages), then a normal
         lookup pins them — admission then proceeds exactly as a local
         hit, so bit-equality and pin discipline need no second path.
         Any failure (NACK, dead pool, layout mismatch) returns ``None``
         and the admit falls through to cold prefill."""
+        rid = req.rid if req is not None else None
+        ctx = getattr(req, "_ctx", None) if req is not None else None
         try:
-            hashes = page_hashes(prompt, self.kvstore.page_tokens,
-                                 limit=int(prompt.shape[0]) - 1)
-            if not hashes:
-                return None
-            pages = self.kvpool.fetch(hashes)
-            if not pages:
-                self.counters.pool_nacks += 1
-                return None
-            self.kvstore.put_pages(hashes[:len(pages)], pages)
-            match = self.kvstore.lookup(prompt)
-            if match is not None:
-                self.counters.pool_hits += 1
-                self.counters.pool_hit_tokens += match.tokens
-            return match
+            with self._tracer.span("serve/pool_fetch", rid=rid) as sp:
+                hashes = page_hashes(prompt, self.kvstore.page_tokens,
+                                     limit=int(prompt.shape[0]) - 1)
+                if not hashes:
+                    return None
+                pages = self.kvpool.fetch(hashes, ctx=ctx)
+                if not pages:
+                    self.counters.pool_nacks += 1
+                    sp.add(nack=True)
+                    return None
+                self.kvstore.put_pages(hashes[:len(pages)], pages)
+                match = self.kvstore.lookup(prompt)
+                if match is not None:
+                    self.counters.pool_hits += 1
+                    self.counters.pool_hit_tokens += match.tokens
+                    sp.add(hit_tokens=match.tokens)
+                return match
         except Exception:
             self._log.warning("serve: kvpool fetch failed", exc_info=True)
             return None
@@ -885,6 +928,7 @@ class ServingLoop:
         self.latency.queue_wait_ms.record((now - submitted) * 1e3)
         self.latency.e2e_ms.record((done - submitted) * 1e3)
         self.slo_latency.record_e2e(req.slo_class, (done - submitted) * 1e3)
+        self._flow(req, "f", outcome="beam")
         self._results.append(Completed(
             req.rid, done, tokens=toks, n_tok=int(toks.shape[0]),
             via_beam=True, meta=self._meta(),
@@ -963,18 +1007,41 @@ class ServingLoop:
                         self.latency.ttft_ms.record(ttft_ms)
                         self.slo_latency.record_ttft(
                             occ.req.slo_class, ttft_ms)
+                        self._tracer.instant(
+                            "serve/first_token", rid=occ.req.rid,
+                            ttft_ms=ttft_ms, cls=occ.req.slo_class)
         return True
+
+    def _inflight_requests(self) -> List[Request]:
+        """Every request this loop currently owes a result for: queued,
+        in a row, or parked — the flight recorder's inventory."""
+        out: List[Request] = [occ.req for occ in self._rows.values()
+                              if occ is not None]
+        out.extend(t.req for t in self._parked)
+        out.extend(self.queue.pending())
+        return out
 
     def _dump_flight(self, reason: str) -> Optional[str]:
         """Write a flight-recorder dump (loop-local recorder if given,
         else the process-global one); ``None`` when neither is armed.
+        Tail-sampling: the dump metadata lists every in-flight rid with
+        its trace_id, and their contexts promote to sampled — a flight
+        dump is always navigable by request, even at low sampling rates.
         Never raises — the recovery path must run regardless."""
         rec = self._recorder if self._recorder is not None \
             else active_recorder()
         if rec is None:
             return None
+        inflight = []
+        for req in self._inflight_requests():
+            self._promote(req)
+            ctx = getattr(req, "_ctx", None)
+            inflight.append({
+                "rid": req.rid, "cls": req.slo_class,
+                "trace_id": ctx.trace_id if ctx is not None else None,
+            })
         try:
-            return rec.dump(reason)
+            return rec.dump(reason, extra_meta={"inflight": inflight})
         except Exception:
             self._log.warning("serve: flight dump failed", exc_info=True)
             return None
@@ -998,6 +1065,8 @@ class ServingLoop:
                 continue
             toks, n = self._partial(row, occ)
             self.counters.failed += 1
+            self._promote(occ.req)
+            self._flow(occ.req, "f", outcome="failed")
             self._tracer.instant("serve/failed", rid=occ.req.rid,
                                  row=row, reason=reason)
             self._results.append(Failed(
@@ -1111,8 +1180,14 @@ class ServingLoop:
             self.latency.tpot_ms.record(
                 (now - occ.first_tok_at) * 1e3 / (produced - 1)
             )
+        if event == "serve/evict":  # deadline blown mid-decode
+            self._promote(occ.req)
+        self._flow(occ.req, "f",
+                   outcome="evict" if event == "serve/evict"
+                   else "complete")
         self._tracer.instant(event, rid=occ.req.rid, row=row,
-                             n_tok=n_tok, rounds=occ.rounds_seen)
+                             n_tok=n_tok, rounds=occ.rounds_seen,
+                             cls=occ.req.slo_class, e2e_ms=e2e_ms)
 
     def _update_policy(self) -> None:
         before = self.policy.level

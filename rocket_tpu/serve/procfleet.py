@@ -41,7 +41,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
-from rocket_tpu.observe.trace import Histogram
+from rocket_tpu.observe import trace
+from rocket_tpu.observe.trace import Histogram, OffsetEstimator
 from rocket_tpu.serve import wire
 from rocket_tpu.serve.metrics import ClassLatency, ServeLatency
 from rocket_tpu.serve.types import HealthState, ReplicaId, Request
@@ -106,6 +107,12 @@ class ProcReplica:
         self.spawn_ms = Histogram()
         self.heal_ms = Histogram()
         self.first_token_ms = Histogram()
+        # Per-connection clock alignment (distributed tracing): every
+        # reply carrying the worker's ``mono_ns`` stamp — STEP each
+        # round, PONG each probe — feeds the estimator, so the offset
+        # tracks drift continuously; ``observe.timeline`` shifts the
+        # worker's ring by -offset when stitching.
+        self.clock_offset = OffsetEstimator()
         # heal() asks this for an already-warm standby replica before
         # paying a cold respawn; wired by the Autoscaler's standby pool.
         self.standby_source: Optional[Callable[[], Optional[Any]]] = None
@@ -194,6 +201,7 @@ class ProcReplica:
         if self._dead is not None:
             return None
         with self._lock:
+            t0 = time.perf_counter_ns()
             try:
                 wire.send_msg(self._fs, kind, payload)
                 rkind, reply = wire.recv_msg(
@@ -207,6 +215,9 @@ class ProcReplica:
             if rkind == wire.ERROR:
                 self._dead = f"worker error on {kind}: {reply}"
                 return None
+            if isinstance(reply, dict) and "mono_ns" in reply:
+                self.clock_offset.add(
+                    t0, int(reply["mono_ns"]), time.perf_counter_ns())
             return reply
 
     # -- router-facing surface -----------------------------------------
@@ -274,6 +285,12 @@ class ProcReplica:
             return False
         with self._lock:
             results = reply.get("results", ())
+            for res in results:
+                # delivery marker: the instant the typed result landed
+                # back supervisor-side — the critical-path analyzer's
+                # "delivery" segment is terminal-event → this stamp.
+                trace.instant("fleet/delivered", rid=res.rid,
+                              replica=str(self.replica_id))
             if results and self._first_token_pending:
                 # spawn→first-token: the latency a request routed to a
                 # fresh (or healed) replica actually experienced.
@@ -317,14 +334,21 @@ class ProcReplica:
         self._rpc(wire.DRAIN)
 
     def swap_weights(self, path: str, version: Optional[int] = None, *,
-                     deep_verify: bool = True) -> bool:
+                     deep_verify: bool = True, ctx: Optional[Any] = None
+                     ) -> bool:
         """One NEW_WEIGHTS RPC: the worker verifies + hot-swaps between
         decode rounds (structurally — this frame cannot overlap a STEP).
         ``False`` on rejection OR replica death; a rejection leaves the
-        worker serving its current weights untouched."""
-        reply = self._rpc(wire.NEW_WEIGHTS, {
+        worker serving its current weights untouched.  ``ctx`` (a
+        :class:`~rocket_tpu.observe.trace.TraceContext` minted by the
+        weight feed per publication) rides the frame so the worker's
+        swap span carries the publication's trace_id."""
+        payload: Dict[str, Any] = {
             "path": path, "version": version, "deep_verify": deep_verify,
-        })
+        }
+        if ctx is not None:
+            payload["ctx"] = ctx.to_wire()
+        reply = self._rpc(wire.NEW_WEIGHTS, payload)
         if reply is None:
             return False
         with self._lock:
@@ -516,3 +540,37 @@ class ProcReplica:
         self._reap()
         if self._dead is None:
             self._dead = "closed"
+
+
+# -- clock-offset export for the timeline assembler --------------------------
+
+
+def collect_offsets(replicas: List[Any]) -> Dict[str, Dict[str, float]]:
+    """Per-replica clock-offset snapshot, keyed by replica id: offset_us
+    / rtt_us / samples plus the worker's pid — the alignment table
+    ``observe.timeline`` matches worker dumps against."""
+    out: Dict[str, Dict[str, float]] = {}
+    for rep in replicas:
+        est = getattr(rep, "clock_offset", None)
+        if est is None or len(est) == 0:
+            continue
+        snap = est.snapshot()
+        pid = getattr(rep, "ready_info", {}).get("pid")
+        if pid is None:
+            pid = getattr(rep, "pid", None)
+        if pid is not None:
+            snap["pid"] = float(pid)
+        out[str(rep.replica_id)] = snap
+    return out
+
+
+def write_offsets(replicas: List[Any], trace_dir: str) -> str:
+    """Write :func:`collect_offsets` as ``clock_offsets.json`` under the
+    trace directory the workers dump their rings into."""
+    import json
+
+    os.makedirs(trace_dir, exist_ok=True)
+    path = os.path.join(trace_dir, "clock_offsets.json")
+    with open(path, "w") as f:
+        json.dump(collect_offsets(replicas), f, indent=2, sort_keys=True)
+    return path

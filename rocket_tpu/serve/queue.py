@@ -141,6 +141,15 @@ class AdmissionQueue:
         return sum(len(self._queues[c]) for c in SLO_CLASSES
                    if c != "batch")
 
+    def pending(self) -> List[Request]:
+        """Point-in-time list of every queued request (priority-class
+        order) — the flight recorder's in-flight inventory; the queue
+        keeps ownership, nothing is popped."""
+        out: List[Request] = []
+        for cls in SLO_CLASSES:
+            out.extend(self._queues[cls])
+        return out
+
     def offer(self, request: Request) -> bool:
         """Enqueue; ``False`` when full — globally, or past the
         request's class slot/byte budget (the caller sheds with a typed

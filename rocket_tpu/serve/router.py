@@ -40,6 +40,7 @@ import time
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from rocket_tpu.observe.recorder import active_recorder
+from rocket_tpu.observe.trace import TraceContext
 from rocket_tpu.serve.fleet import PrefillReplica, Replica
 from rocket_tpu.serve.metrics import (
     ClassLatency,
@@ -119,6 +120,18 @@ class FleetRouter:
         fleet-level rejection (also recorded)."""
         with self._lock:
             self.counters.submitted += 1
+            if getattr(req, "_ctx", None) is None:
+                # fleet entry is the earliest stamp point for routed
+                # requests: mint the context, emit the flow START here,
+                # and hand every downstream hop (replica loop, wire,
+                # pool) a child — they continue the chain with "t"/"f",
+                # never a second start
+                ctx = TraceContext.make(req.rid)
+                if self._tracer is not None and ctx.sampled:
+                    self._tracer.flow("serve/request", "s", ctx.flow_id,
+                                      rid=req.rid)
+                req._ctx = ctx.child("fleet")
+            req._route_t0 = time.perf_counter_ns()
             return self._route(req)
 
     def _route(self, req: Request) -> Optional[Any]:
@@ -127,7 +140,8 @@ class FleetRouter:
             for rep in target:
                 if rep.submit(req):
                     self._instant("fleet/route", rid=req.rid,
-                                  lane="prefill", replica=rep.replica_id)
+                                  lane="prefill", replica=rep.replica_id,
+                                  route_ms=self._route_ms(req))
                     self.counters.routed += 1
                     return None
             # prefill lane saturated or dead: fall through — the decode
@@ -185,12 +199,16 @@ class FleetRouter:
                 if req.session is not None:
                     self._affinity[req.session] = rep.replica_id
                 self._instant("fleet/route", rid=req.rid, lane="decode",
-                              replica=rep.replica_id, affine=affine)
+                              replica=rep.replica_id, affine=affine,
+                              route_ms=self._route_ms(req))
                 self.counters.routed += 1
                 self.counters.observe_class(req.slo_class, "routed")
                 return None
         self.counters.shed_saturated += 1
         self.counters.observe_class(req.slo_class, "shed_saturated")
+        ctx = getattr(req, "_ctx", None)
+        if ctx is not None:
+            ctx.sampled = True  # bad outcome — always worth a trace
         self._instant("fleet/saturated", rid=req.rid)
         rej = Overloaded(req.rid, self._clock(), reason="fleet saturated",
                          meta={"replica": None, "level": None})
@@ -200,6 +218,16 @@ class FleetRouter:
     @staticmethod
     def _least_loaded(reps: List[Any]) -> List[Any]:
         return sorted(reps, key=lambda r: r.load)
+
+    @staticmethod
+    def _route_ms(req: Request) -> float:
+        """Milliseconds spent inside fleet routing for this request —
+        from the submit stamp to the moment a replica accepts it (the
+        critical-path analyzer reads this off ``fleet/route``)."""
+        t0 = getattr(req, "_route_t0", None)
+        if t0 is None:
+            return 0.0
+        return round((time.perf_counter_ns() - t0) / 1e6, 3)
 
     def _deliver(self, kind: str, req: Request, payload: Any) -> None:
         """Prefill-lane completion callback (runs on the prefill driver
@@ -219,16 +247,32 @@ class FleetRouter:
                 # miss there only costs the cold prefill we skipped).
                 self.counters.pool_handoffs += 1
                 self._instant("fleet/pool_handoff", rid=req.rid,
-                              nbytes=int(payload or 0))
+                              nbytes=int(payload or 0),
+                              wire_ms=self._handoff_ms(req))
+                # re-stamp: the decode hop's route_ms must not re-count
+                # the prefill + handoff time already attributed above
+                req._route_t0 = time.perf_counter_ns()
                 self._route_decode(req)
                 return
             handoff = payload
             self.counters.handoffs += 1
             self.counters.handoff_bytes += int(handoff.nbytes)
             self._instant("fleet/handoff", rid=req.rid,
-                          nbytes=int(handoff.nbytes))
+                          nbytes=int(handoff.nbytes),
+                          wire_ms=self._handoff_ms(req))
             req._handoff = handoff
+            req._route_t0 = time.perf_counter_ns()
             self._route_decode(req)
+
+    @staticmethod
+    def _handoff_ms(req: Request) -> float:
+        """Milliseconds between the prefill replica finishing the
+        request's prefill and the handoff reaching the router — the
+        wire/queue cost of lane disaggregation."""
+        done = getattr(req, "_prefill_done_ns", None)
+        if done is None:
+            return 0.0
+        return round((time.perf_counter_ns() - done) / 1e6, 3)
 
     # -- supervision / self-healing ------------------------------------
 
@@ -250,12 +294,23 @@ class FleetRouter:
         self._log.warning("fleet: healing replica %s (%s)",
                           rep.replica_id, reason)
         self._dump_flight(f"replica-death-{rep.replica_id}")
+        heal_t0 = time.perf_counter_ns()
         final, salvaged = rep.heal()
+        heal_ms = round((time.perf_counter_ns() - heal_t0) / 1e6, 3)
         with self._lock:
             self.counters.heals += 1
             self.counters.requeued += len(salvaged)
             self._results.extend(final)
             self._retry.extend(salvaged)
+            for req in salvaged:
+                # a request that survived a replica death is exactly the
+                # kind worth a full trace: promote past head-sampling and
+                # put the heal on its critical path
+                ctx = getattr(req, "_ctx", None)
+                if ctx is not None:
+                    ctx.sampled = True
+                self._instant("fleet/requeued", rid=req.rid,
+                              replica=rep.replica_id, heal_ms=heal_ms)
             # the rebuilt replica's prefix store lost nothing, but any
             # in-flight pins died with the old loop; sessions stamped to
             # it must re-route freely (their next turn re-stamps)
@@ -287,6 +342,9 @@ class FleetRouter:
                 # salvaged requests keep their remaining deadline; the
                 # route sheds or serves them like any fresh arrival, and
                 # saturation still yields a typed result — exactly once
+                # (route_ms restarts here: the heal time is attributed
+                # to the heal segment via fleet/requeued, not the route)
+                req._route_t0 = time.perf_counter_ns()
                 self._route(req)
 
     def _dump_flight(self, reason: str) -> Optional[str]:

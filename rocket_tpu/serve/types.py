@@ -76,6 +76,12 @@ class Request:
     per-class budgets and shed accounting, and — for ``batch`` — cheap
     round-boundary preemption (evict-to-kvstore, resume later,
     bit-equal).  Both cross the RPC wire.
+
+    Distributed tracing stamps a private
+    :class:`~rocket_tpu.observe.trace.TraceContext` as ``_ctx`` at
+    submit (same convention as the other lifecycle stamps ``_submit_ts``
+    / ``_enq_ts`` / ``_handoff``); it rides the v3 wire frames so every
+    process a request visits tags its events with the same trace_id.
     """
 
     rid: Any

@@ -29,6 +29,7 @@ from typing import Any, Callable, Dict, Optional, Tuple
 
 import numpy as np
 
+from rocket_tpu.observe.trace import TraceContext
 from rocket_tpu.serve.types import Request
 from rocket_tpu.utils.framing import FramedSocket
 
@@ -45,7 +46,13 @@ from rocket_tpu.utils.framing import FramedSocket
 #      the SUBMIT frame (a v1 peer would silently drop the class and
 #      serve batch floods at interactive priority, so this is a
 #      compatibility break, not an additive field).
-PROTOCOL_VERSION = 2
+#   3: distributed request tracing — a TraceContext 3-tuple rides
+#      SUBMIT / FETCH_PAGES / NEW_WEIGHTS payloads ("ctx") and STEP /
+#      PONG replies carry the worker's perf_counter_ns ("mono_ns") for
+#      per-connection clock-offset estimation.  Both are read with
+#      tolerant .get() — a v2 frame unpacks with ctx=None, unsampled —
+#      so the bump documents intent; degradation is graceful.
+PROTOCOL_VERSION = 3
 
 
 class ProtocolMismatch(RuntimeError):
@@ -217,6 +224,9 @@ def pack_request(req: Request, *,
     handoff = getattr(req, "_handoff", None)
     if handoff is not None:
         out["handoff"] = handoff.to_host()
+    ctx = getattr(req, "_ctx", None)
+    if ctx is not None:
+        out["ctx"] = ctx.to_wire()
     return out
 
 
@@ -236,4 +246,10 @@ def unpack_request(wire: Dict[str, Any], *,
     handoff = wire.get("handoff")
     if handoff is not None:
         req._handoff = handoff
+    ctx = TraceContext.from_wire(wire.get("ctx"))
+    if ctx is not None:
+        # crossing the wire makes this a CHILD hop: a non-empty parent
+        # tells the worker-side serve loop to emit a flow continuation
+        # ("t"), never a second flow start for the same request
+        req._ctx = ctx.child(ctx.parent or "wire")
     return req

@@ -113,7 +113,7 @@ def restore_params(restore_dir: str, targets: Any) -> Any:
 
 
 def serve(fs: FramedSocket, loop: Any, *,
-          clock=time.monotonic) -> int:
+          clock=time.monotonic, on_shutdown=None) -> int:
     """Answer the supervisor's RPC stream until SHUTDOWN or socket loss.
 
     Every request gets exactly one reply frame; an exception escaping a
@@ -146,6 +146,11 @@ def serve(fs: FramedSocket, loop: Any, *,
                     "latency": loop.latency,
                     "slo_latency": getattr(loop, "slo_latency", None),
                     "counters": loop.counters.snapshot(),
+                    # v3: this clock stamp + the supervisor's send/recv
+                    # stamps feed the per-connection OffsetEstimator, so
+                    # offset drift is re-measured every round, not just
+                    # at PING cadence.
+                    "mono_ns": time.perf_counter_ns(),
                 }
                 if kvstore is not None:
                     reply["kv_hashes"] = kvstore.drain_new_hashes()
@@ -158,6 +163,7 @@ def serve(fs: FramedSocket, loop: Any, *,
                     "load": int(loop.load),
                     "health": loop.health.value,
                     "pid": os.getpid(),
+                    "mono_ns": time.perf_counter_ns(),
                 })
             elif kind == wire.DRAIN:
                 loop.drain()
@@ -174,6 +180,12 @@ def serve(fs: FramedSocket, loop: Any, *,
                 # construction: STEP RPCs are the only way rounds run,
                 # and the supervisor's one-in-flight discipline means
                 # this frame can never overlap one.
+                from rocket_tpu.observe import trace as _tr
+                ctx = _tr.TraceContext.from_wire(payload.get("ctx"))
+                if ctx is not None and ctx.sampled:
+                    _tr.instant("serve/new_weights",
+                                trace_id=ctx.trace_id,
+                                version=payload.get("version"))
                 ok = loop.swap_weights(
                     payload["path"], payload.get("version"),
                     deep_verify=bool(payload.get("deep_verify", True)))
@@ -202,6 +214,15 @@ def serve(fs: FramedSocket, loop: Any, *,
                     "compile_cache": _cc.snapshot(),
                 })
             elif kind == wire.SHUTDOWN:
+                if on_shutdown is not None:
+                    # flush side outputs (the tracer's ring dump) BEFORE
+                    # the BYE ships: the supervisor reaps — SIGKILL —
+                    # the moment it reads the reply, so anything written
+                    # after is a lost race
+                    try:
+                        on_shutdown()
+                    except Exception:
+                        pass
                 wire.send_msg(fs, wire.BYE, {"results": loop.drain_results()})
                 try:
                     loop.close()
@@ -279,6 +300,24 @@ def main(argv: Optional[list] = None) -> int:
             wire.send_msg(fs, wire.ERROR, traceback.format_exc())
             return 2
         build_ms = (time.perf_counter() - t_build) * 1e3
+        # Distributed tracing: with ROCKET_TPU_TRACE_DIR set (the
+        # supervisor exports it before spawning), arm this process's
+        # tracer, label the ring with the worker's fleet identity, and
+        # dump it into the shared directory at orderly exit — the
+        # timeline assembler stitches those dumps against the
+        # supervisor's ring using the per-connection clock offsets.
+        trace_dir = os.environ.get("ROCKET_TPU_TRACE_DIR")
+        tracer = None
+        if trace_dir:
+            from rocket_tpu.observe import trace as _trace
+
+            tracer = _trace.arm()
+            tracer.set_anchor()
+            tracer.meta.update({
+                "role": "worker",
+                "replica": args.replica_id or "worker",
+                "pid": os.getpid(),
+            })
         import jax
 
         wire.send_msg(fs, wire.READY, {
@@ -293,7 +332,22 @@ def main(argv: Optional[list] = None) -> int:
             "cache_dir": cache_armed,
             "warm_stats": dict(getattr(loop, "warm_stats", None) or {}),
         })
-        return serve(fs, loop)
+        dump = None
+        if tracer is not None:
+            def dump() -> None:
+                name = (f"worker-{args.replica_id or 'worker'}-"
+                        f"{os.getpid()}.json")
+                tracer.dump_json(os.path.join(trace_dir, name))
+        rc = serve(fs, loop, on_shutdown=dump)
+        if tracer is not None:
+            try:
+                # socket-loss exits (supervisor gone) never saw SHUTDOWN
+                # — dump here too; after an orderly exit this just
+                # rewrites the same file
+                dump()
+            except Exception:
+                pass  # a failed dump must not turn a clean exit dirty
+        return rc
     finally:
         fs.close()
 

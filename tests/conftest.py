@@ -142,13 +142,15 @@ _HEAVY_TAIL = (
     "test_kvpool_proc.py",
     "test_trainserve.py",
     "test_tenants_proc.py",
+    "test_tracing_proc.py",
 )
 
 
 # The newest spawn-heavy file runs LAST of all: when the timed tier-1
 # budget truncates, the cut lands on the newest coverage first and the
 # long-standing seed suite still runs to completion.
-_TAIL_END = ("test_trainserve.py", "test_tenants_proc.py")
+_TAIL_END = ("test_trainserve.py", "test_tenants_proc.py",
+             "test_tracing_proc.py")
 
 
 def pytest_collection_modifyitems(config, items):

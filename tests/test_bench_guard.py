@@ -291,6 +291,100 @@ class TestTracingOverheadGuard:
         )
 
 
+# -- distributed-tracing guard (ISSUE 19 acceptance) -----------------------
+#
+# The request-tracing tentpole's promise: stamping a TraceContext on
+# every request and emitting its flow chain (s -> t... -> f) at sampling
+# rate 1.0 is pure host bookkeeping — a crc32, a dataclass, a ring
+# append per hop.  Armed, a serve round must trace ZERO new jitted
+# bodies and stay within 5% host overhead of the disarmed loop (same
+# tolerance discipline as the guards above).
+
+
+@pytest.mark.tracing
+class TestTraceCtxGuard:
+    def test_ctx_stamped_round_overhead_and_trace_count(self, devices):
+        import jax
+        import numpy as np
+
+        from rocket_tpu.models.generate import ContinuousBatcher, _spec_round
+        from rocket_tpu.models.transformer import (
+            TransformerConfig,
+            TransformerLM,
+        )
+        from rocket_tpu.observe.trace import (
+            Tracer,
+            get_sampling,
+            set_sampling,
+        )
+        from rocket_tpu.serve import Request, ServingLoop
+
+        B, P, TOTAL, NDRAFT = 3, 8, 24, 4
+
+        def _lm(seed):
+            cfg = TransformerConfig(
+                vocab_size=64, hidden=32, n_layers=2, n_heads=4, max_seq=64,
+            )
+            m = TransformerLM(cfg)
+            p = m.init(
+                jax.random.PRNGKey(seed),
+                {"tokens": np.zeros((1, P), np.int32),
+                 "positions": np.zeros((1, P), np.int32)},
+            )["params"]
+            return m, p
+
+        model, params = _lm(1)
+        draft, _ = _lm(1)
+        _, dparams = _lm(7)
+        rng = np.random.default_rng(13)
+        prompts = rng.integers(1, 64, size=(B, P)).astype(np.int32)
+
+        def factory():
+            return ContinuousBatcher(
+                model, draft, params, dparams,
+                total_len=TOTAL, n_draft=NDRAFT, eos_token=None,
+            )
+
+        rounds = 8
+
+        def round_times(tracer):
+            loop = ServingLoop(factory, max_batch=B, queue_capacity=8,
+                               watchdog_timeout=30.0, tracer=tracer)
+            for i in range(B):
+                loop.submit(Request(rid=i, prompt=prompts[i]))
+            loop.run_round()  # admits + settles
+            out = []
+            for _ in range(rounds):
+                t0 = time.perf_counter()
+                loop.run_round()
+                out.append(time.perf_counter() - t0)
+            loop.run_until_idle()  # terminal "f" flow events emit here
+            loop.close()
+            return out
+
+        rate, seed = get_sampling()
+        set_sampling(1.0, 0)     # every request stamped AND flow-traced
+        try:
+            bare = float(np.median(round_times(Tracer(enabled=False))))
+            traces_before = _spec_round._cache_size()
+            armed_tracer = Tracer(capacity=4096, enabled=True)
+            armed = float(np.median(round_times(armed_tracer)))
+        finally:
+            set_sampling(rate, seed)
+        # ctx stamping + flow emission traced zero new jitted bodies...
+        assert _spec_round._cache_size() == traces_before
+        # ...while really recording every request's full flow chain
+        phases = [f.get("ph") for k, n, _ts, _d, _t, f
+                  in armed_tracer.events()
+                  if k == "F" and n == "serve/request"]
+        assert phases.count("s") == B and phases.count("f") == B
+        assert "t" in phases
+        assert armed <= bare * 1.05 + 5e-4, (
+            f"ctx-stamped round {armed * 1e3:.3f}ms vs bare "
+            f"{bare * 1e3:.3f}ms"
+        )
+
+
 # -- async-loop guard (ISSUE 5 acceptance) --------------------------------
 #
 # The non-blocking Looper's promise: with readback deferred k iterations,

@@ -22,7 +22,9 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 PKG = os.path.join(REPO, "rocket_tpu")
 
 # The emitting calls whose first positional argument is an event name.
-_EMITTERS = {"span", "counter", "instant", "health"}
+# ``_instant`` is FleetRouter's tracer-guarded wrapper — same first-arg
+# contract, so its fleet/* names lint too.
+_EMITTERS = {"span", "counter", "instant", "health", "flow", "_instant"}
 
 # lowercase slug segments joined by '/' — at least one slash (a bare
 # word has no category and collides with everything).  Dots are allowed
@@ -92,7 +94,12 @@ def test_library_emits_trace_events():
     assert {"serve/submit", "ledger/compile",
             "quant/int8_matmul/fallback",
             # multi-tenant serving: preemption lifecycle markers
-            "serve/preempt", "serve/resume"} <= names
+            "serve/preempt", "serve/resume",
+            # distributed request tracing: the stitched-timeline and
+            # critical-path event vocabulary (docs/observability.md)
+            "serve/request", "serve/pool_fetch", "serve/first_token",
+            "serve/new_weights", "fleet/delivered", "fleet/requeued",
+            "pool/fetch"} <= names
 
 
 # -- jax.jit chokepoint lint (ISSUE 15 satellite) ----------------------------

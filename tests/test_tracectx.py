@@ -1,0 +1,500 @@
+"""Distributed request tracing — fast units (see docs/observability.md
+"Distributed request tracing").
+
+Covers the pieces the proc acceptance test (test_tracing_proc.py)
+composes: TraceContext determinism + head-sampling, wire v2<->v3
+tolerance and the typed ProtocolMismatch both directions, clock-offset
+estimation edges (asymmetric RTT, drift between pings, negative
+offset), flow-event Chrome validity, critical-path decomposition on
+synthetic rings, timeline stitching with known offsets, tail-sampled
+flight-dump metadata, and the admission queue's non-popping inventory.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from rocket_tpu.observe.trace import (  # noqa: E402
+    OffsetEstimator,
+    TraceContext,
+    Tracer,
+    get_sampling,
+    set_sampling,
+)
+
+pytestmark = pytest.mark.tracing
+
+
+# -- TraceContext -------------------------------------------------------------
+
+
+class TestTraceContext:
+    def teardown_method(self):
+        set_sampling(1.0, 0)
+
+    def test_make_is_deterministic_across_processes(self):
+        # same rid + same sampling config -> identical trace_id and
+        # keep/drop decision, with no shared state (the cross-process
+        # agreement the wire protocol leans on)
+        a = TraceContext.make("req-7")
+        b = TraceContext.make("req-7")
+        assert a == b
+        assert a.trace_id.endswith("-req-7")
+
+    def test_sampling_rate_holds(self):
+        set_sampling(0.25, seed=3)
+        assert get_sampling() == (0.25, 3)
+        kept = sum(TraceContext.make(f"r{i}").sampled for i in range(2000))
+        assert 0.18 < kept / 2000 < 0.32
+        # rate 0 and 1 are exact
+        set_sampling(0.0)
+        assert not any(
+            TraceContext.make(f"r{i}").sampled for i in range(50))
+        set_sampling(1.0)
+        assert all(TraceContext.make(f"r{i}").sampled for i in range(50))
+
+    def test_seed_varies_the_subset(self):
+        picks = []
+        for seed in (0, 1):
+            set_sampling(0.5, seed=seed)
+            picks.append({i for i in range(200)
+                          if TraceContext.make(f"r{i}").sampled})
+        assert picks[0] != picks[1]
+
+    def test_wire_roundtrip_and_child(self):
+        ctx = TraceContext.make("abc")
+        rt = TraceContext.from_wire(ctx.to_wire())
+        assert rt == ctx
+        kid = ctx.child("wire")
+        assert kid.trace_id == ctx.trace_id
+        assert kid.parent == "wire"
+        assert kid.flow_id == ctx.flow_id  # the chain key never changes
+
+    def test_from_wire_tolerates_garbage(self):
+        for junk in (None, 7, "x", (1, 2), ("a", "b", True, "extra"),
+                     (7, "p", True), [None, "", False]):
+            assert TraceContext.from_wire(junk) is None
+
+
+# -- wire v2 <-> v3 matrix ----------------------------------------------------
+
+
+class TestWireV3:
+    def test_v3_request_roundtrips_ctx(self):
+        from rocket_tpu.serve.types import Request
+        from rocket_tpu.serve.wire import pack_request, unpack_request
+
+        req = Request(rid="r1", prompt=np.arange(4, dtype=np.int32))
+        req._ctx = TraceContext.make("r1")
+        out = unpack_request(pack_request(req))
+        assert out._ctx.trace_id == req._ctx.trace_id
+        assert out._ctx.sampled == req._ctx.sampled
+        # crossing the wire marks the hop: the worker-side submit must
+        # emit a flow step ("t"), never a second start
+        assert out._ctx.parent == "wire"
+
+    def test_v2_frame_unpacks_without_ctx(self):
+        from rocket_tpu.serve.types import Request
+        from rocket_tpu.serve.wire import pack_request, unpack_request
+
+        req = Request(rid="r2", prompt=np.arange(4, dtype=np.int32))
+        wire = pack_request(req)        # no _ctx stamped -> no "ctx" key
+        assert "ctx" not in wire
+        out = unpack_request(wire)
+        assert getattr(out, "_ctx", None) is None
+        # a v2 peer that pickled extra garbage into ctx degrades the
+        # same way — None, never an exception
+        wire["ctx"] = {"not": "a tuple"}
+        assert getattr(unpack_request(wire), "_ctx", None) is None
+
+    def test_protocol_mismatch_both_directions(self):
+        from rocket_tpu.serve.wire import (
+            PROTOCOL_VERSION,
+            ProtocolMismatch,
+            WorkerSpec,
+            check_hello,
+            check_ready,
+            hello_payload,
+        )
+
+        assert PROTOCOL_VERSION == 3
+        spec = WorkerSpec(builder="m:f")
+        # matched versions pass both ways
+        assert check_hello(hello_payload(spec)) is spec
+        assert check_ready({"proto": PROTOCOL_VERSION})["proto"] == 3
+        # worker side rejects a v2 supervisor
+        with pytest.raises(ProtocolMismatch) as ei:
+            check_hello({"proto": 2, "spec": spec})
+        assert ei.value.theirs == 2 and ei.value.side == "worker"
+        # supervisor side rejects a v2 worker
+        with pytest.raises(ProtocolMismatch) as ei:
+            check_ready({"proto": 2})
+        assert ei.value.side == "supervisor"
+        # pre-versioning peers count as version 0
+        with pytest.raises(ProtocolMismatch):
+            check_hello(spec)
+        with pytest.raises(ProtocolMismatch):
+            check_ready({})
+
+
+# -- clock-offset estimation --------------------------------------------------
+
+
+class TestOffsetEstimator:
+    def test_symmetric_exchange_recovers_offset(self):
+        est = OffsetEstimator()
+        true_offset = 5_000_000          # worker 5ms ahead
+        t0 = 1_000_000
+        tw = (t0 + 500_000) + true_offset   # reply stamped mid-flight
+        est.add(t0, tw, t0 + 1_000_000)
+        assert est.offset_ns == true_offset
+        assert est.rtt_ns == 1_000_000
+
+    def test_asymmetric_rtt_error_bounded_by_half_rtt(self):
+        # transit 100us out, 900us back: the midpoint assumption is
+        # maximally wrong, but the error stays within rtt/2
+        est = OffsetEstimator()
+        true_offset = 2_000_000
+        t0 = 10_000_000
+        tw = t0 + 100_000 + true_offset
+        t1 = t0 + 1_000_000
+        est.add(t0, tw, t1)
+        assert abs(est.offset_ns - true_offset) <= est.rtt_ns // 2
+
+    def test_min_rtt_sample_wins_over_congested_ones(self):
+        est = OffsetEstimator()
+        true_offset = 3_000_000
+        # congested exchanges with asymmetric queueing (bad estimates)
+        for i in range(5):
+            t0 = i * 100_000_000
+            est.add(t0, t0 + 8_000_000 + true_offset, t0 + 9_000_000)
+        # one tight exchange
+        t0 = 900_000_000
+        est.add(t0, t0 + 50_000 + true_offset, t0 + 100_000)
+        assert est.rtt_ns == 100_000
+        assert abs(est.offset_ns - true_offset) <= 50_000
+
+    def test_drift_between_pings_tracked_by_window(self):
+        # offset drifts 1us per exchange; the bounded window forgets
+        # old samples so the estimate follows, within rtt/2 of current
+        est = OffsetEstimator(window=4)
+        for i in range(20):
+            off = 1_000_000 + i * 1_000
+            t0 = i * 10_000_000
+            est.add(t0, t0 + 50_000 + off, t0 + 100_000)
+        current = 1_000_000 + 19 * 1_000
+        assert abs(est.offset_ns - current) <= 4_000 + 50_000
+
+    def test_negative_offset_and_backwards_clock(self):
+        est = OffsetEstimator()
+        t0 = 50_000_000
+        est.add(t0, t0 + 100_000 - 7_000_000, t0 + 200_000)  # worker behind
+        assert est.offset_ns < 0
+        assert abs(est.offset_ns - (-7_000_000)) <= est.rtt_ns // 2
+        # a sample where the supervisor clock ran backwards is rejected
+        n = len(est)
+        est.add(t0, t0, t0 - 1)
+        assert len(est) == n
+
+    def test_empty_estimator_answers_none(self):
+        est = OffsetEstimator()
+        assert est.offset_ns is None and est.rtt_ns is None
+        assert est.snapshot() == {"samples": 0.0}
+
+    def test_stitched_ordering_stays_monotone_per_request(self):
+        # supervisor events at t=10,40; worker events (clock +off) that
+        # REALLY happened at t=20,30.  After stitching (ts_w - offset)
+        # the per-request order is monotone for positive AND negative
+        # offsets.
+        for true_offset_us in (7_000.0, -7_000.0):
+            est = OffsetEstimator()
+            t0 = 1_000_000
+            off_ns = int(true_offset_us * 1e3)
+            est.add(t0, t0 + 50_000 + off_ns, t0 + 100_000)
+            est_off_us = est.offset_ns / 1e3
+            sup = [("fleet/route", 10.0), ("fleet/delivered", 40.0)]
+            wrk = [("serve/admit", 20.0 + true_offset_us),
+                   ("serve/complete", 30.0 + true_offset_us)]
+            stitched = sup + [(n, ts - est_off_us) for n, ts in wrk]
+            stitched.sort(key=lambda e: e[1])
+            assert [n for n, _ in stitched] == [
+                "fleet/route", "serve/admit", "serve/complete",
+                "fleet/delivered"]
+
+
+# -- flow events / Chrome export ---------------------------------------------
+
+
+class TestFlowEvents:
+    def test_flow_chain_exports_valid_chrome_phases(self):
+        tr = Tracer(enabled=True)
+        ctx = TraceContext.make("r9")
+        tr.flow("serve/request", "s", ctx.flow_id, rid="r9")
+        tr.flow("serve/request", "t", ctx.flow_id, rid="r9", hop="admit")
+        tr.flow("serve/request", "f", ctx.flow_id, rid="r9",
+                outcome="complete")
+        evs = [e for e in tr.to_chrome()["traceEvents"]
+               if e["name"] == "serve/request"]
+        assert [e["ph"] for e in evs] == ["s", "t", "f"]
+        assert len({e["id"] for e in evs}) == 1
+        assert all(e["cat"] == "request" for e in evs)
+        # chrome schema: ph/id/cat are event fields, not args; the
+        # finish binds to its enclosing slice
+        assert all("ph" not in e["args"] and "id" not in e["args"]
+                   for e in evs)
+        assert evs[-1]["bp"] == "e"
+        assert evs[1]["args"]["hop"] == "admit"
+        json.dumps(tr.to_chrome())  # serializable end to end
+
+    def test_tracer_meta_rides_dump_metadata(self, tmp_path):
+        tr = Tracer(enabled=True)
+        tr.meta.update({"role": "worker", "replica": "w0", "pid": 123})
+        tr.instant("serve/submit", rid="r")
+        path = tr.dump_json(str(tmp_path / "worker-w0-123.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        assert doc["metadata"]["role"] == "worker"
+        assert doc["metadata"]["replica"] == "w0"
+
+    def test_disarmed_flow_is_noop(self):
+        tr = Tracer(enabled=False)
+        tr.flow("serve/request", "s", 1)
+        assert tr.events() == []
+
+
+# -- critical-path decomposition ---------------------------------------------
+
+
+def _ring(events_ms):
+    """Synthetic ring tuples from (name, t_ms, dur_ms, fields)."""
+    out = []
+    for name, t_ms, dur_ms, fields in events_ms:
+        kind = "X" if dur_ms else "I"
+        out.append((kind, name, int(t_ms * 1e6), int(dur_ms * 1e6),
+                    1, dict(fields)))
+    return out
+
+
+class TestCritPath:
+    def test_segments_sum_to_e2e_on_synthetic_request(self):
+        from rocket_tpu.observe.critpath import analyze_events
+
+        ring = _ring([
+            ("serve/submit", 0, 0, {"rid": "r1", "cls": "interactive",
+                                    "trace_id": "ab-r1"}),
+            ("fleet/route", 1, 0, {"rid": "r1", "route_ms": 1.0}),
+            ("fleet/prefill", 1, 4, {"rid": "r1", "replica": "p0"}),
+            ("fleet/handoff", 6, 0, {"rid": "r1", "wire_ms": 1.0}),
+            ("serve/pool_fetch", 7, 2, {"rid": "r1"}),
+            ("serve/admit", 9, 3, {"rid": "r1", "queue_wait_ms": 2.0}),
+            ("serve/preempt", 14, 0, {"rid": "r1"}),
+            ("serve/resume", 18, 0, {"rid": "r1"}),
+            ("serve/first_token", 13, 0, {"rid": "r1", "ttft_ms": 13.0}),
+            ("serve/complete", 22, 0, {"rid": "r1", "cls": "interactive",
+                                       "e2e_ms": 22.0}),
+            ("fleet/delivered", 23, 0, {"rid": "r1"}),
+        ])
+        (p,) = analyze_events(ring)
+        assert p.cls == "interactive" and p.trace_id == "ab-r1"
+        s = p.segments
+        assert s["route"] == pytest.approx(1.0)
+        # prefill-lane span (4) + the admit span (3): the admit IS the
+        # row's prefill work (KV import here, full prefill when local)
+        assert s["prefill"] == pytest.approx(7.0)
+        assert s["handoff_wire"] == pytest.approx(1.0)
+        assert s["pool_fetch"] == pytest.approx(2.0)
+        assert s["queue_wait"] == pytest.approx(2.0)
+        assert s["preempt_parked"] == pytest.approx(4.0)
+        # decode = terminal(22) - admit_end(12) - parked(4) = 6
+        assert s["decode_rounds"] == pytest.approx(6.0)
+        assert s["delivery"] == pytest.approx(1.0)
+        assert p.ttft_ms == pytest.approx(13.0)
+        assert p.e2e_ms == pytest.approx(22.0)
+        assert p.dominant == "prefill"
+
+    def test_heal_segment_lands_on_critical_path(self):
+        from rocket_tpu.observe.critpath import analyze_events
+
+        ring = _ring([
+            ("serve/submit", 0, 0, {"rid": "r2", "cls": "standard"}),
+            ("fleet/requeued", 5, 0, {"rid": "r2", "heal_ms": 50.0}),
+            ("serve/admit", 60, 1, {"rid": "r2", "queue_wait_ms": 3.0}),
+            ("serve/complete", 70, 0, {"rid": "r2", "cls": "standard",
+                                       "e2e_ms": 70.0}),
+        ])
+        (p,) = analyze_events(ring)
+        assert p.segments["heal"] == pytest.approx(50.0)
+        assert p.dominant == "heal"
+
+    def test_only_terminated_requests_emerge(self):
+        from rocket_tpu.observe.critpath import analyze_events
+
+        ring = _ring([
+            ("serve/submit", 0, 0, {"rid": "done"}),
+            ("serve/complete", 5, 0, {"rid": "done", "e2e_ms": 5.0}),
+            ("serve/submit", 1, 0, {"rid": "inflight"}),
+        ])
+        assert [p.rid for p in analyze_events(ring)] == ["done"]
+
+    def test_stats_snapshot_is_sum_mergeable(self):
+        from rocket_tpu.observe.critpath import (
+            aggregate,
+            analyze_events,
+            format_table,
+        )
+        from rocket_tpu.observe.export import merge_counters
+
+        ring = _ring([
+            ("serve/submit", 0, 0, {"rid": "a", "cls": "interactive"}),
+            ("serve/admit", 1, 2, {"rid": "a", "queue_wait_ms": 1.0}),
+            ("serve/complete", 9, 0, {"rid": "a", "cls": "interactive",
+                                      "e2e_ms": 9.0}),
+            ("serve/submit", 0, 0, {"rid": "b", "cls": "batch"}),
+            ("serve/admit", 2, 1, {"rid": "b", "queue_wait_ms": 2.0}),
+            ("serve/evict", 30, 0, {"rid": "b", "cls": "batch",
+                                    "e2e_ms": 30.0}),
+        ])
+        stats = aggregate(analyze_events(ring))
+        snap = stats.snapshot()
+        assert snap["interactive/count"] == 1.0
+        assert snap["batch/count"] == 1.0
+        assert snap["batch/dominant_decode_rounds"] == 1.0
+        # no /pNN keys — merge_counters SUMs everything here
+        assert not any(k.rsplit("/", 1)[-1].startswith("p")
+                       and k.rsplit("/", 1)[-1][1:].isdigit()
+                       for k in snap)
+        merged = merge_counters([snap, snap])
+        assert merged["interactive/count"] == 2.0
+        assert merged["interactive/e2e_ms_total"] == \
+            pytest.approx(2 * snap["interactive/e2e_ms_total"])
+        table = format_table(stats)
+        assert "interactive" in table and "queue_wait" in table
+
+    def test_critpath_source_exports_prometheus(self):
+        from rocket_tpu.observe import export
+        from rocket_tpu.observe.critpath import (
+            CritPathStats,
+            RequestPath,
+            register_critpath_source,
+        )
+
+        stats = CritPathStats()
+        stats.record(RequestPath(
+            "r", cls="interactive", e2e_ms=4.0,
+            segments={"decode_rounds": 4.0}))
+        name = register_critpath_source(stats)
+        try:
+            text = export.prometheus_text(export.collect())
+            assert "rocket_tpu_serve_critpath_interactive_count 1" \
+                in text.replace(".0", "")
+        finally:
+            export.unregister_source(name)
+
+
+# -- timeline stitching -------------------------------------------------------
+
+
+class TestTimelineStitch:
+    def _write(self, path, events, meta):
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "metadata": meta}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+
+    def test_offset_stitch_aligns_and_orders(self, tmp_path):
+        from rocket_tpu.observe.timeline import (
+            request_timelines,
+            stitch_timeline,
+        )
+
+        # worker clock runs 500000us AHEAD of the supervisor's
+        off_us = 500_000.0
+        self._write(tmp_path / "supervisor.json", [
+            {"name": "fleet/route", "ph": "i", "ts": 100.0, "pid": 0,
+             "tid": 1, "args": {"rid": "r1"}},
+            {"name": "fleet/delivered", "ph": "i", "ts": 900.0, "pid": 0,
+             "tid": 1, "args": {"rid": "r1"}},
+        ], {"process_index": 0})
+        self._write(tmp_path / "worker-w0-42.json", [
+            {"name": "serve/admit", "ph": "X", "ts": 200.0 + off_us,
+             "dur": 50.0, "pid": 0, "tid": 2, "args": {"rid": "r1"}},
+            {"name": "serve/complete", "ph": "i", "ts": 800.0 + off_us,
+             "pid": 0, "tid": 2, "args": {"rid": "r1"}},
+        ], {"process_index": 0, "role": "worker", "replica": "w0",
+            "pid": 42})
+        with open(tmp_path / "clock_offsets.json", "w") as f:
+            json.dump({"w0": {"offset_us": off_us, "rtt_us": 80.0,
+                              "samples": 4, "pid": 42}}, f)
+        out_path = str(tmp_path / "timeline.json")
+        doc = stitch_timeline(str(tmp_path), out_path=out_path)
+        assert os.path.exists(out_path)
+        lanes = {l["label"]: l for l in doc["metadata"]["lanes"]}
+        assert lanes["w0"]["aligned"] == "offset"
+        assert lanes["w0"]["shift_us"] == pytest.approx(-off_us)
+        (rid_events,) = request_timelines(doc).values()
+        assert [e["name"] for e in rid_events] == [
+            "fleet/route", "serve/admit", "serve/complete",
+            "fleet/delivered"]
+        # distinct perfetto lanes, named
+        assert len({e["pid"] for e in rid_events}) == 2
+        names = [e for e in doc["traceEvents"]
+                 if e.get("ph") == "M" and e["name"] == "process_name"]
+        assert len(names) == 2
+
+    def test_unmatched_worker_falls_back_to_wall_anchor(self, tmp_path):
+        from rocket_tpu.observe.timeline import stitch_timeline
+
+        self._write(tmp_path / "supervisor.json", [], {
+            "process_index": 0, "anchor_wall_s": 100.0,
+            "anchor_perf_us": 1_000.0})
+        self._write(tmp_path / "worker-w9-7.json", [
+            {"name": "serve/submit", "ph": "i", "ts": 2_000.0, "pid": 0,
+             "tid": 1, "args": {"rid": "r"}}], {
+            "process_index": 0, "role": "worker", "replica": "w9",
+            "pid": 7, "anchor_wall_s": 100.5, "anchor_perf_us": 500.0})
+        doc = stitch_timeline(str(tmp_path))  # no offsets file at all
+        lane = [l for l in doc["metadata"]["lanes"]
+                if l["label"] == "w9"][0]
+        assert lane["aligned"] == "wall_anchor"
+        # (100.5-100)*1e6 - 500 + 1000
+        assert lane["shift_us"] == pytest.approx(500_500.0)
+        assert not doc["metadata"]["unaligned_files"]
+
+
+# -- tail sampling / flight dumps --------------------------------------------
+
+
+class TestTailSampling:
+    def test_dump_metadata_carries_extra_meta(self, tmp_path):
+        from rocket_tpu.observe.recorder import FlightRecorder
+
+        tr = Tracer(enabled=True)
+        tr.instant("serve/submit", rid="r1")
+        rec = FlightRecorder(tr, out_dir=str(tmp_path))
+        path = rec.dump("test", extra_meta={"inflight": [
+            {"rid": "r1", "cls": "interactive", "trace_id": "ab-r1"}]})
+        with open(os.path.join(path, "trace.json")) as f:
+            doc = json.load(f)
+        assert doc["metadata"]["dump_reason"] == "test"
+        assert doc["metadata"]["inflight"][0]["trace_id"] == "ab-r1"
+
+    def test_queue_pending_inventory_does_not_pop(self):
+        from rocket_tpu.serve.queue import AdmissionQueue
+        from rocket_tpu.serve.types import Request
+
+        q = AdmissionQueue(capacity=8)
+        for i, cls in enumerate(("batch", "interactive", "standard")):
+            assert q.offer(Request(rid=f"r{i}",
+                                   prompt=np.arange(4, dtype=np.int32),
+                                   slo_class=cls))
+        inv = q.pending()
+        # priority-class order, nothing consumed
+        assert [r.slo_class for r in inv] == [
+            "interactive", "standard", "batch"]
+        assert len(q) == 3
+        assert [r.rid for r in q.pending()] == [r.rid for r in inv]
