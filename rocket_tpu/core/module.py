@@ -152,6 +152,9 @@ class Module(Dispatcher):
         self._accum = 1
         self._window_buffer: list = []
         self._pending_restore: Optional[Any] = None
+        # ZeRO opt-state host-offload round-trip driver (engine.offload);
+        # built at materialization when Runtime(zero_offload=True).
+        self._offloader: Optional[Any] = None
 
     # -- setup / teardown ---------------------------------------------------
 
@@ -178,6 +181,9 @@ class Module(Dispatcher):
             return
         if self._runtime is not None:
             self._runtime.deregister_unique("model", self._adapter)
+        if self._offloader is not None:
+            self._offloader.close()
+            self._offloader = None
         # Keep self._state: the trained params outlive the run, the way the
         # reference's torch module keeps its weights after launch.
         self._steps = None
@@ -505,13 +511,26 @@ class Module(Dispatcher):
         donate = donate and jax.default_backend() != "cpu"
         if self._tx is not None:
             if self._use_window:
+                from rocket_tpu.parallel.sharding import ZeroIncompatibleError
+
                 plan = getattr(self, "_sharding_plan", None)
                 if plan is not None and plan.zero_stage >= 1:
-                    raise ValueError(
-                        "zero_stage=1 is not supported with "
-                        "fuse_accumulation — the fused window step applies "
-                        "the update outside the ZeRO shard domain; use "
-                        "micro/sync accumulation"
+                    raise ZeroIncompatibleError(
+                        "fuse_accumulation", plan.zero_stage,
+                        "use the default micro/sync accumulation "
+                        "(fuse_accumulation=False)",
+                        detail="the fused window step applies the update "
+                        "outside the ZeRO shard domain",
+                    )
+                if getattr(self._runtime, "zero_offload", False):
+                    raise ZeroIncompatibleError(
+                        "zero_offload + fuse_accumulation",
+                        getattr(plan, "zero_stage", 0),
+                        "use the default micro/sync accumulation so the "
+                        "offloader sees a sync boundary per window",
+                        detail="zero_offload prefetches opt state at "
+                        "micro/sync boundaries the fused window step does "
+                        "not expose",
                     )
                 if skip:
                     self._logger.warning(
@@ -552,6 +571,30 @@ class Module(Dispatcher):
         self._eval_step = build_eval_step(
             self._adapter.apply_fn, self._objectives, policy=policy,
             use_ema=self._eval_with_ema,
+            shard_plan=getattr(self, "_sharding_plan", None),
+        )
+        self._configure_offload()
+
+    def _configure_offload(self) -> None:
+        """(Re)build the opt-state host-offload driver when the runtime
+        asks for it — one per materialization, closed on rebuild."""
+        if self._offloader is not None:
+            self._offloader.close()
+            self._offloader = None
+        plan = getattr(self, "_sharding_plan", None)
+        if (
+            not getattr(self._runtime, "zero_offload", False)
+            or self._tx is None
+            or plan is None
+            or plan.zero_stage < 1
+        ):
+            return
+        from rocket_tpu.engine.offload import ZeroOffloader
+
+        self._offloader = ZeroOffloader(plan.opt_shardings)
+        self._logger.info(
+            "zero_offload armed: opt state round-trips host RAM per sync "
+            "boundary (double-buffered prefetch; see docs/performance.md)"
         )
 
     def _restore_state(self, abstract_state: TrainState, shardings: Any) -> None:
@@ -559,6 +602,27 @@ class Module(Dispatcher):
 
         spec = self._pending_restore
         self._pending_restore = None
+        # Stage-transition visibility: the manifest stamps the SAVING
+        # run's ZeRO stage; the restore target's specs come from THIS
+        # run's plan, so a stage change is just a reshard — but a silent
+        # one is undebuggable, so log it.  Legacy stage-less manifests
+        # (no stamp) restore through the unchanged strict path.
+        try:
+            from rocket_tpu.persist.integrity import manifest_mesh
+
+            saved_stage = (manifest_mesh(str(spec.path)) or {}).get(
+                "zero_stage"
+            )
+        except Exception:
+            saved_stage = None
+        run_stage = int(getattr(self._runtime, "zero_stage", 0) or 0)
+        if saved_stage is not None and int(saved_stage) != run_stage:
+            self._logger.info(
+                "elastic restore across ZeRO stage transition: snapshot "
+                "saved at zero_stage=%d, run uses zero_stage=%d — "
+                "resharding through this run's plan",
+                int(saved_stage), run_stage,
+            )
         if spec.load_capsules:
             # Full resume: whole TrainState (params, optimizer moments, step,
             # rng), restored sharded, direct to mesh layout.
@@ -639,6 +703,15 @@ class Module(Dispatcher):
             else:
                 synced = (self._micro_idx + 1) % self._accum == 0
                 step = self._steps["sync" if synced else "micro"]
+                if synced and self._offloader is not None:
+                    # Join the opt-state prefetch started after the LAST
+                    # sync step: same tree structure and shardings as the
+                    # live opt_state, so swapping it in re-uses the
+                    # compiled step (zero retrace).  Any wait here books
+                    # into the ledger's offload_wait bucket.
+                    self._state = self._state.replace(
+                        opt_state=self._offloader.fetch(self._state.opt_state)
+                    )
                 if self._lr_scale is None:
                     self._state, logs = step(self._state, batch)
                 else:
@@ -648,6 +721,10 @@ class Module(Dispatcher):
                     self._state, logs = step(
                         self._state, batch, jnp.float32(self._lr_scale)
                     )
+                if synced and self._offloader is not None:
+                    # Start the D2H writeback + next-step H2D prefetch;
+                    # it overlaps the next window's forward/backward.
+                    self._offloader.stash(self._state.opt_state)
                 self._micro_idx = 0 if synced else self._micro_idx + 1
                 logs = Attributes(logs)
                 logs.synced = synced
@@ -732,15 +809,21 @@ class Module(Dispatcher):
     def memory_plan(self) -> Optional[dict]:
         """Per-device byte accounting of the materialized state under its
         sharding plan (``{'param_bytes', 'opt_bytes', 'other_bytes',
-        'total_bytes'}`` — see :func:`rocket_tpu.engine.state.memory_plan`).
-        None before materialization."""
+        'total_bytes', 'host_opt_bytes'}`` — see
+        :func:`rocket_tpu.engine.state.memory_plan`, and the ZeRO stage
+        decision table in ``docs/performance.md`` for the per-stage
+        formulas).  ``Runtime(zero_offload=True)`` moves the opt bytes to
+        ``host_opt_bytes``.  None before materialization."""
         plan = getattr(self, "_sharding_plan", None)
         abstract = getattr(self, "_abstract_state", None)
         if plan is None or abstract is None:
             return None
         from rocket_tpu.engine.state import memory_plan
 
-        return memory_plan(abstract, plan.state_specs, plan.mesh)
+        return memory_plan(
+            abstract, plan.state_specs, plan.mesh,
+            zero_offload=bool(getattr(self._runtime, "zero_offload", False)),
+        )
 
     def state_dict(self) -> Attributes:
         if self._state is None:

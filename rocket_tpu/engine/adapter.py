@@ -170,6 +170,12 @@ def state_shardings(
     replicates.  (The old tree-path-*suffix* heuristic this replaces
     silently took the first hit's spec when two params shared a suffix
     and shape — see tests/test_sharding_rules.py for the regression.)
+
+    ``zero_stage`` (0-3, arXiv 2004.13336) selects how much of the state
+    the plan data-shards: 1 = optimizer mirrors, 2 = + grad-accum
+    buffers, 3 = + the params' storage domain itself (the step
+    all-gathers on demand) — see the stage decision table in
+    ``docs/performance.md``.
     """
     from rocket_tpu.parallel.sharding import (
         DEFAULT_PARTITION_RULES,

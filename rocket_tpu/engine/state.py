@@ -109,13 +109,29 @@ def _leaf_device_bytes(leaf: Any, spec: Any, mesh: Any) -> int:
     return int(math.prod(shape or [1])) * itemsize
 
 
-def memory_plan(abstract: TrainState, state_specs: TrainState, mesh: Any) -> dict:
+def memory_plan(
+    abstract: TrainState,
+    state_specs: TrainState,
+    mesh: Any,
+    zero_offload: bool = False,
+) -> dict:
     """Per-device byte accounting of a TrainState under a spec tree.
 
-    Returns ``{'param_bytes', 'opt_bytes', 'other_bytes', 'total_bytes'}``
-    — what the sharding plan says each device holds at steady state
-    (arguments only; activations/temps are the compiler's side).  This is
-    the number the bench ladder reports and the ZeRO guard asserts on.
+    Returns ``{'param_bytes', 'opt_bytes', 'other_bytes', 'total_bytes',
+    'host_opt_bytes'}`` — what the sharding plan says each device holds at
+    steady state (arguments only; activations/temps are the compiler's
+    side).  This is the number the bench ladder reports and the ZeRO guard
+    asserts on.  ``state_specs`` already encodes the ZeRO stage: at stage
+    2 the grad-accum buffers, and at stage 3 the params themselves, carry
+    data-composed specs, so the per-stage memory formula (see the stage
+    decision table in ``docs/performance.md``) falls out of the same spec
+    arithmetic with no stage special-casing here.
+
+    ``zero_offload=True`` moves the optimizer-state bytes to the host
+    tier: ``opt_bytes`` drops out of the device ``total_bytes`` and is
+    reported as ``host_opt_bytes`` instead (each host holds its shard-
+    owners' opt state in RAM; the double-buffered prefetch transiently
+    re-materializes one step's worth on device during the update).
     """
     from jax.sharding import PartitionSpec
 
@@ -132,9 +148,16 @@ def memory_plan(abstract: TrainState, state_specs: TrainState, mesh: Any) -> dic
     param_bytes = section_bytes(abstract.params, state_specs.params)
     opt_bytes = section_bytes(abstract.opt_state, state_specs.opt_state)
     total_bytes = section_bytes(abstract, state_specs)
+    other_bytes = total_bytes - param_bytes - opt_bytes
+    host_opt_bytes = 0
+    if zero_offload:
+        host_opt_bytes = opt_bytes
+        opt_bytes = 0
+        total_bytes = param_bytes + other_bytes
     return {
         "param_bytes": param_bytes,
         "opt_bytes": opt_bytes,
-        "other_bytes": total_bytes - param_bytes - opt_bytes,
+        "other_bytes": other_bytes,
         "total_bytes": total_bytes,
+        "host_opt_bytes": host_opt_bytes,
     }

@@ -174,15 +174,29 @@ def build_train_step(
 
     ``shard_plan`` (a :class:`rocket_tpu.parallel.sharding.ShardingPlan`
     with ``zero_stage >= 1``) turns on ZeRO-style cross-replica
-    weight-update sharding (arXiv 2004.13336) inside the step: gradients
-    are pinned to the params' sharding (so the backward subprogram stays
-    identical to the unsharded step), then sliced to the data-composed
-    shard domain; the optax update and the ``params + update`` add both
-    run on the shard; the updated params are all-gathered back to the
-    base domain; the new optimizer state stays on the shard.  The two
-    explicit pins around the apply-add keep XLA's mul+add FMA contraction
-    on-shard — exactly the grouping the unsharded step fuses — which is
-    what makes the trajectory bit-equal, not just numerically close.
+    weight-update sharding (arXiv 2004.13336) inside the step.  At
+    **stage 1** gradients are pinned to the params' sharding (so the
+    backward subprogram stays identical to the unsharded step), then
+    sliced to the data-composed shard domain; the optax update and the
+    ``params + update`` add both run on the shard; the updated params
+    are all-gathered back to the base domain; the new optimizer state
+    stays on the shard.  The two explicit pins around the apply-add keep
+    XLA's mul+add FMA contraction on-shard — exactly the grouping the
+    unsharded step fuses — which is what makes the trajectory bit-equal,
+    not just numerically close.
+
+    **Stage 2** drops the base-domain pin on gradients: fresh grads are
+    constrained straight to the zero shard, so GSPMD lowers the data-axis
+    gradient reduction as a **reduce-scatter into the shard owner**
+    instead of an all-reduce followed by a local slice — half the comm
+    volume and no full-gradient replica.  Accumulation buffers live on
+    the shard too (``specs_for_state`` re-partitions them), so the
+    micro-window sum is an elementwise on-shard add — still exact.
+    **Stage 3** additionally stores the params themselves on the zero
+    shard: the top of the forward pins ``state.params`` to the base
+    compute domain (the **all-gather on demand**), the update runs
+    shard-to-shard, and the new params are pinned back to — and stay on —
+    the shard, keeping the jit signature and the donation path intact.
     With ``shard_plan=None`` (or ``zero_stage=0``) the step body is
     byte-identical to the pre-ZeRO one.
 
@@ -225,15 +239,34 @@ def build_train_step(
     loss_fn = build_loss_fn(apply_fn, objectives, policy)
     grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
     n = gradient_accumulation_steps
-    zero = shard_plan is not None and getattr(shard_plan, "zero_stage", 0) >= 1
+    stage = getattr(shard_plan, "zero_stage", 0) if shard_plan is not None else 0
+    zero = stage >= 1
 
     def forward_backward(state: TrainState, batch: Any):
         rng = jax.random.fold_in(state.rng, state.step)
         if state.micro is not None:
             rng = jax.random.fold_in(rng, state.micro)
+        params = state.params
+        if stage >= 3:
+            # All-gather on demand: storage is the ZeRO shard; the compute
+            # domain is the base param sharding.  One pin at the top of the
+            # forward is the whole gather — backward reuses the gathered
+            # buffers, so the loss/grad subprogram matches the unsharded
+            # step bit-for-bit.
+            params = jax.lax.with_sharding_constraint(
+                params, shard_plan.param_shardings
+            )
         (loss, (logs, new_mutable, _)), grads = grad_fn(
-            state.params, state.mutable, rng, batch
+            params, state.mutable, rng, batch
         )
+        if stage >= 2:
+            # Stage 2+: constrain fresh grads straight to the ZeRO shard.
+            # GSPMD lowers the data-axis psum feeding a sharded consumer as
+            # a reduce-scatter into the shard owner — no full-gradient
+            # replica ever materializes.
+            grads = jax.lax.with_sharding_constraint(
+                grads, shard_plan.zero_param_shardings
+            )
         return loss, grads, new_mutable, logs
 
     def micro_step(state: TrainState, batch: Any, lr_scale=None):
@@ -276,15 +309,18 @@ def build_train_step(
 
         def apply_update(grads):
             if zero:
-                # Pin grads to the base param domain first (forces the
-                # backward to match the unsharded step bit-for-bit), then
-                # slice them — and the params — to the ZeRO shard.
-                grads = jax.lax.with_sharding_constraint(
-                    grads, shard_plan.param_shardings
-                )
-                grads = jax.lax.with_sharding_constraint(
-                    grads, shard_plan.zero_param_shardings
-                )
+                if stage == 1:
+                    # Stage 1: pin grads to the base param domain first
+                    # (forces the backward to match the unsharded step
+                    # bit-for-bit), then slice them — and the params — to
+                    # the ZeRO shard.  Stage 2+ grads are already on-shard
+                    # (reduce-scattered in forward_backward).
+                    grads = jax.lax.with_sharding_constraint(
+                        grads, shard_plan.param_shardings
+                    )
+                    grads = jax.lax.with_sharding_constraint(
+                        grads, shard_plan.zero_param_shardings
+                    )
                 params_in = jax.lax.with_sharding_constraint(
                     state.params, shard_plan.zero_param_shardings
                 )
@@ -301,13 +337,17 @@ def build_train_step(
             if zero:
                 # The shard-domain pin BEFORE the gather keeps the
                 # params+update add (and its FMA contraction) on-shard;
-                # the second constraint is then a pure all-gather.
+                # at stages 1/2 the second constraint is then a pure
+                # all-gather back to the base storage domain.  Stage 3
+                # params are STORED on the shard — no gather, the output
+                # sharding matches the (donated) input's.
                 new_params = jax.lax.with_sharding_constraint(
                     new_params, shard_plan.zero_param_shardings
                 )
-                new_params = jax.lax.with_sharding_constraint(
-                    new_params, shard_plan.param_shardings
-                )
+                if stage < 3:
+                    new_params = jax.lax.with_sharding_constraint(
+                        new_params, shard_plan.param_shardings
+                    )
                 new_opt_state = jax.lax.with_sharding_constraint(
                     new_opt_state, shard_plan.opt_shardings
                 )
@@ -493,6 +533,7 @@ def build_eval_step(
     objectives: Sequence[Objective] = (),
     policy: Policy = Policy(),
     use_ema: bool = False,
+    shard_plan: Optional[Any] = None,
 ) -> Callable[[TrainState, Any], Tuple[Any, Dict[str, Any]]]:
     """Jitted evaluation step: forward only (reference eval path — grads off
     make Loss/Optimizer/Scheduler no-ops, ``loss.py:88-89``,
@@ -502,7 +543,15 @@ def build_eval_step(
     ``use_ema=True`` evaluates with the parameter EMA maintained by
     ``Optimizer(ema_decay=...)`` instead of the live params (the usual
     inference weights for EMA-trained models); requires the transform to
-    be in the chain."""
+    be in the chain.
+
+    ``shard_plan`` with ``zero_stage >= 1`` pins the eval params to the
+    base compute domain: a no-op at stages 1/2, and the all-gather from
+    ZeRO-3's sharded storage (live params OR the EMA, which lives in the
+    shard-domain opt_state) at stage 3."""
+    eval_stage = (
+        getattr(shard_plan, "zero_stage", 0) if shard_plan is not None else 0
+    )
 
     def eval_step(state: TrainState, batch: Any):
         params = state.params
@@ -514,6 +563,10 @@ def build_eval_step(
                     "optimizer chain — set Optimizer(ema_decay=...)"
                 )
             params = ema
+        if eval_stage >= 1:
+            params = jax.lax.with_sharding_constraint(
+                params, shard_plan.param_shardings
+            )
         params = policy.cast_to_compute(params)
         batch_out, _ = apply_fn(params, state.mutable, state.rng, batch, False)
         logs: Dict[str, Any] = {}

@@ -81,12 +81,14 @@ class Launcher(Dispatcher):
         goodput: bool = True,
         metrics_port: Optional[int] = None,
         zero_stage: int = 0,
+        zero_offload: bool = False,
     ) -> None:
         super().__init__(
             capsules=capsules, statefull=statefull, priority=priority, logger=logger
         )
         self._tag = tag
         self._zero_stage = int(zero_stage)
+        self._zero_offload = bool(zero_offload)
         self._num_epochs = int(num_epochs)
         self._mesh = mesh
         self._mixed_precision = mixed_precision
@@ -143,6 +145,7 @@ class Launcher(Dispatcher):
             seed=self._seed,
             tracing=self._tracing,
             zero_stage=self._zero_stage,
+            zero_offload=self._zero_offload,
         )
         runtime.project_dir = self._resolve_project_dir()
         if runtime.project_dir is not None:

@@ -336,11 +336,11 @@ class GoodputLedger:
     BUCKETS: Tuple[str, ...] = (
         "productive", "compile", "host_blocked", "data_starved",
         "checkpoint", "watchdog_rebuild", "preemption_loss",
-        "serve/kvstore/wire", "swap",
+        "serve/kvstore/wire", "swap", "offload_wait",
     )
     NESTED: Tuple[str, ...] = (
         "compile", "data_starved", "checkpoint", "watchdog_rebuild",
-        "serve/kvstore/wire", "swap",
+        "serve/kvstore/wire", "swap", "offload_wait",
     )
 
     def __init__(self) -> None:

@@ -58,14 +58,40 @@ to the unsharded path (Adam and Muon, ± EMA)::
 
 Muon's rank-2 params are exempt (Newton-Schulz orthogonalization reduces
 over the full matrix); grad-accum buffers stay at base sharding (the
-micro-sum must be elementwise-exact); ``zero_stage=1`` is incompatible
-with ``fuse_accumulation`` windows.  At Llama-2-7B full-finetune with
+micro-sum must be elementwise-exact); ZeRO stages are incompatible
+with ``fuse_accumulation`` windows (:class:`ZeroIncompatibleError`).
+At Llama-2-7B full-finetune with
 Adam on a pure 8-way ``data`` mesh this turns 25.1 GB of replicated
 moments into 3.1 GB per device — 40.3 GB of step arguments (provably over
 a 32 GB v4 chip) down to 15.7 GB (AOT-compiles within the envelope); the
 worked example lives in ``docs/performance.md`` and is pinned by
 ``tests/test_ladder_shapes.py::test_llama2_7b_full_finetune_zero1_fits_v4_hbm``
 and ``tests/test_bench_guard.py::TestZeroGuard``.
+
+**ZeRO stages 2 and 3** extend the same composition through the rest of
+the state:
+
+- ``zero_stage=2`` additionally moves the *gradient accumulation
+  buffers* into the zero domain and pins fresh gradients straight to it
+  inside the step — GSPMD then lowers the data-axis gradient reduction
+  as a **reduce-scatter into the shard owner** instead of an all-reduce
+  followed by a local slice (half the comm volume, no full-gradient
+  replica materialized).  The micro-window sum stays elementwise on the
+  shard, so accumulation remains exact.
+- ``zero_stage=3`` additionally shards the **parameters themselves**:
+  ``state_specs.params`` (the storage/donation domain) becomes the
+  zero-composed spec tree and the step **all-gathers params on demand**
+  at the top of the forward (one ``with_sharding_constraint`` to the
+  base compute domain), so the full parameter replica exists only
+  transiently inside the step — this is the FSDP shape of the paper.
+
+Every stage keeps the trajectory bit-equal to the unsharded oracle (the
+same constraint-chain discipline; ``tests/test_sharding_rules.py``
+covers adam/muon ± ema ± gradient accumulation at every stage), and the
+Muon rank-2 exemption applies to all three stages.  Per-chip state cost:
+``P + O`` at stage 0/1 (``O/N`` at 1), ``P + O/N`` plus ``A/N``
+accumulation at stage 2, and ``P/N + O/N`` at stage 3 — the decision
+table with comm volumes lives in ``docs/performance.md``.
 """
 
 from __future__ import annotations
@@ -270,6 +296,34 @@ def canonical_path(path: Any) -> str:
 
 class UnmatchedLeafError(ValueError):
     """A leaf no rule matches — names the exact leaf path."""
+
+
+# Stages implemented by the rule engine (arXiv 2004.13336): 0 = off,
+# 1 = optimizer state, 2 = + gradients (reduce-scatter), 3 = + params
+# (all-gather-on-demand / FSDP).
+ZERO_STAGES = (0, 1, 2, 3)
+
+
+class ZeroIncompatibleError(ValueError):
+    """A ZeRO stage/offload setting combined with a feature it cannot
+    support.  One typed error per genuinely incompatible combination —
+    carries the offending ``feature``, the ``zero_stage``, and the
+    ``remedy`` (also baked into the message) instead of a bare string.
+    """
+
+    def __init__(self, feature: str, zero_stage: int, remedy: str,
+                 detail: str = "") -> None:
+        self.feature = feature
+        self.zero_stage = int(zero_stage)
+        self.remedy = remedy
+        msg = (
+            f"zero_stage={int(zero_stage)} is not supported with "
+            f"{feature}"
+        )
+        if detail:
+            msg += f" — {detail}"
+        msg += f". Remedy: {remedy}."
+        super().__init__(msg)
 
 
 def _leaf_size(shape: Sequence[int]) -> int:
@@ -498,10 +552,14 @@ class ShardingPlan:
     """One coherent sharding resolution for a full TrainState.
 
     ``state_specs``/``state_shardings`` mirror the TrainState structure;
-    ``param_specs`` is the base (non-ZeRO) param spec tree the forward/
-    backward runs under; ``zero_param_shardings`` is the data-composed
-    domain the optimizer update runs in when ``zero_stage >= 1`` (equal to
-    ``param_shardings`` otherwise)."""
+    ``param_specs`` is the base (non-ZeRO) *compute* spec tree the
+    forward/backward runs under; ``zero_param_shardings`` is the
+    data-composed domain the optimizer update runs in when
+    ``zero_stage >= 1`` (equal to ``param_shardings`` otherwise).  At
+    ``zero_stage=3`` the params' *storage* domain
+    (``state_specs.params`` / ``state_shardings.params``) is the zero
+    domain too — the step all-gathers to ``param_shardings`` on demand
+    and never stores the gathered replica."""
 
     mesh: Mesh
     rules: PartitionRules
@@ -559,6 +617,7 @@ def specs_for_state(
     rules: PartitionRules = DEFAULT_PARTITION_RULES,
     param_specs: Any = None,
     zero_stage: int = 0,
+    make_shardings: bool = True,
 ) -> ShardingPlan:
     """Resolve shardings for every leaf of a TrainState from one rule table.
 
@@ -567,14 +626,28 @@ def specs_for_state(
     shadows, grad-accum buffers) inherit the param specs positionally;
     non-mirror leaves fall back to scalar-replication, then the regex
     rules on their canonical path, then replication.  With
-    ``zero_stage=1`` mirror leaves (minus matrix-update-exempt params) are
-    re-partitioned over the ``data`` axis via :func:`zero_compose`.
+    ``zero_stage >= 1`` mirror leaves (minus matrix-update-exempt params)
+    are re-partitioned over the ``data`` axis via :func:`zero_compose`;
+    ``zero_stage >= 2`` moves the grad-accum buffers into the same zero
+    domain (the window sum is elementwise on the shard, still exact);
+    ``zero_stage=3`` stores the params themselves there — the step
+    all-gathers them to the base compute domain on demand.
 
     ``param_specs`` overrides rule-derived param specs (the Module passes
     annotation-derived specs through here so existing models keep their
     exact layouts); when ``None`` the rules must cover every param leaf or
     :class:`UnmatchedLeafError` is raised naming the path.
+
+    ``make_shardings=False`` skips :class:`~jax.sharding.NamedSharding`
+    construction (the plan's ``*_shardings`` fields are ``None``) so the
+    spec/byte arithmetic also runs against a *hypothetical* mesh — any
+    object with a ``.shape`` mapping of axis sizes, e.g. a pod shape this
+    host doesn't have.  ``bench.py``'s 30B memory-plan rows use this.
     """
+    if zero_stage not in ZERO_STAGES:
+        raise ValueError(
+            f"zero_stage must be one of {ZERO_STAGES}, got {zero_stage!r}"
+        )
     params = abstract_state.params
     if param_specs is None:
         param_specs = rules.specs_for_tree(params)
@@ -643,13 +716,34 @@ def specs_for_state(
 
     state_specs = abstract_state.replace(
         step=P(),
-        params=param_spec_tree,
+        # Stage 3: the params' STORAGE domain is the zero shard — the step
+        # all-gathers to the base compute domain on demand, so no full
+        # replica persists between steps.
+        params=mirror_spec_tree if zero_stage >= 3 else param_spec_tree,
         opt_state=resolve_collection(abstract_state.opt_state, mirror_spec_tree),
         rng=P(),
         mutable=resolve_collection(abstract_state.mutable, param_spec_tree),
-        grad_accum=resolve_collection(abstract_state.grad_accum, param_spec_tree),
+        # Stage 2+: accumulation buffers live on the zero shard too — the
+        # micro-sum is elementwise on the shard (exact) and gradients
+        # reduce-scatter straight into it.
+        grad_accum=resolve_collection(
+            abstract_state.grad_accum,
+            mirror_spec_tree if zero_stage >= 2 else param_spec_tree,
+        ),
         micro=None if abstract_state.micro is None else P(),
     )
+
+    if not make_shardings:
+        return ShardingPlan(
+            mesh=mesh,
+            rules=rules,
+            zero_stage=zero_stage,
+            param_specs=param_spec_tree,
+            state_specs=state_specs,
+            param_shardings=None,
+            zero_param_shardings=None,
+            state_shardings=None,
+        )
 
     to_sharding = lambda spec: NamedSharding(mesh, spec)
     as_shardings = lambda specs: jax.tree_util.tree_map(

@@ -322,6 +322,7 @@ class Checkpointer(Capsule):
                         getattr(self._runtime, "partition_rules", None)
                         or getattr(self._runtime, "rules", None)
                     ),
+                    zero_stage=getattr(self._runtime, "zero_stage", None),
                 )
         if (
             self._publish_every is not None
@@ -397,6 +398,7 @@ class Checkpointer(Capsule):
                 getattr(self._runtime, "partition_rules", None)
                 or getattr(self._runtime, "rules", None)
             ),
+            zero_stage=getattr(self._runtime, "zero_stage", None),
         )
         # Prune BEFORE appending the new path, so retention counts only
         # already-issued saves: the newest tracked entry always exists on
@@ -446,6 +448,7 @@ class Checkpointer(Capsule):
                     getattr(self._runtime, "partition_rules", None)
                     or getattr(self._runtime, "rules", None)
                 ),
+                zero_stage=getattr(self._runtime, "zero_stage", None),
             )
 
     def _collect_items(self) -> dict:

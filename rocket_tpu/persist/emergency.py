@@ -107,7 +107,7 @@ class EmergencyTier:
         self._keep = int(keep)
         self._logger = logger if logger is not None else _logger
         self._staged: Optional[Tuple[Dict[str, Any], int, Optional[int],
-                                     Any, Any]] = None
+                                     Any, Any, Optional[int]]] = None
         self.captures = 0
         self.flushes = 0
 
@@ -121,6 +121,7 @@ class EmergencyTier:
         epoch_idx: Optional[int] = None,
         mesh: Any = None,
         rules: Any = None,
+        zero_stage: Optional[int] = None,
     ) -> None:
         """Stage ``items`` (capsule-key → state pytree) for a later flush.
 
@@ -136,7 +137,8 @@ class EmergencyTier:
             # the next donated step dispatch — pin host copies now (the
             # async copies above overlap this sync across all leaves).
             items = {key: _to_host(tree) for key, tree in items.items()}
-        self._staged = (items, int(iter_idx), epoch_idx, mesh, rules)
+        self._staged = (items, int(iter_idx), epoch_idx, mesh, rules,
+                        zero_stage)
         self.captures += 1
 
     @property
@@ -157,13 +159,14 @@ class EmergencyTier:
         staged, self._staged = self._staged, None
         if staged is None:
             return None
-        items, iter_idx, epoch_idx, mesh, rules = staged
+        items, iter_idx, epoch_idx, mesh, rules, zero_stage = staged
         path = os.path.abspath(
             os.path.join(self._root, self._format.format(iter_idx))
         )
         try:
             host_items = {key: _to_host(tree) for key, tree in items.items()}
-            self._write(path, host_items, iter_idx, epoch_idx, mesh, rules)
+            self._write(path, host_items, iter_idx, epoch_idx, mesh, rules,
+                        zero_stage)
         except Exception:
             # A failing flush must never mask the preemption path (the
             # grace-window durable save may still land).
@@ -186,6 +189,7 @@ class EmergencyTier:
         epoch_idx: Optional[int],
         mesh: Any,
         rules: Any,
+        zero_stage: Optional[int] = None,
     ) -> None:
         import orbax.checkpoint as ocp
 
@@ -207,7 +211,7 @@ class EmergencyTier:
             )
         manifest = integrity.build_manifest(
             items, iter_idx=iter_idx, epoch_idx=epoch_idx,
-            mesh=mesh, rules=rules,
+            mesh=mesh, rules=rules, zero_stage=zero_stage,
         )
         if jax.process_index() == 0:
             with open(os.path.join(path, MARKER), "w") as fh:

@@ -111,18 +111,26 @@ def _leaf_record(path: Any, leaf: Any) -> Dict[str, Any]:
     return record
 
 
-def _mesh_section(mesh: Any, rules: Any) -> Optional[Dict[str, Any]]:
+def _mesh_section(
+    mesh: Any, rules: Any, zero_stage: Optional[int] = None
+) -> Optional[Dict[str, Any]]:
     """The manifest ``mesh`` section: saving topology + logical-axis table.
 
     What elastic restore needs to judge a snapshot: which named axes
     existed (and their sizes), how many devices the mesh spanned, and the
-    logical→mesh mapping the run's specs were derived through."""
+    logical→mesh mapping the run's specs were derived through.
+    ``zero_stage`` (when given) stamps the saving run's ZeRO stage —
+    restore across a stage change is an ordinary reshard (the target
+    specs come from the restoring run's own plan), but the stamp lets the
+    restore path log the transition and tooling price the snapshot."""
     if mesh is None:
         return None
     section: Dict[str, Any] = {
         "axes": {str(name): int(size) for name, size in dict(mesh.shape).items()},
         "device_count": int(mesh.devices.size),
     }
+    if zero_stage is not None:
+        section["zero_stage"] = int(zero_stage)
     if rules is not None:
         table = rules.table() if hasattr(rules, "table") else dict(rules)
         section["rules"] = [
@@ -160,6 +168,7 @@ def build_manifest(
     checksums: bool = True,
     mesh: Any = None,
     rules: Any = None,
+    zero_stage: Optional[int] = None,
 ) -> Dict[str, Any]:
     """Manifest dict for a composite snapshot about to be saved.
 
@@ -167,7 +176,10 @@ def build_manifest(
     latency-critical saves; structure is always recorded.  ``mesh`` (+
     optional ``rules``) stamps the saving topology so the snapshot becomes
     elastic-restorable (schema 2); without it the snapshot restores only
-    onto an identical topology (the schema-1 contract).
+    onto an identical topology (the schema-1 contract).  ``zero_stage``
+    additionally stamps the saving run's ZeRO stage in the mesh section
+    (legacy stage-less manifests restore through the unchanged strict
+    path).
     """
     manifest: Dict[str, Any] = {
         "schema": SCHEMA_VERSION,
@@ -176,7 +188,7 @@ def build_manifest(
         "num_procs": jax.process_count(),
         "items": {},
     }
-    mesh_meta = _mesh_section(mesh, rules)
+    mesh_meta = _mesh_section(mesh, rules, zero_stage=zero_stage)
     if mesh_meta is not None:
         manifest["mesh"] = mesh_meta
     for key, tree in items.items():

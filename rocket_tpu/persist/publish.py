@@ -90,6 +90,7 @@ class WeightPublisher:
         epoch_idx: Optional[int] = None,
         mesh: Any = None,
         rules: Any = None,
+        zero_stage: Optional[int] = None,
     ) -> str:
         """Write ``items`` as the committed publication for ``step`` and
         return its path.  Cheap by the emergency tier's recipe: the
@@ -102,7 +103,8 @@ class WeightPublisher:
             _start_host_copies(tree)
         host_items = {key: _to_host(tree) for key, tree in items.items()}
         path = os.path.join(self._root, self._format.format(int(step)))
-        self._write(path, host_items, int(step), epoch_idx, mesh, rules)
+        self._write(path, host_items, int(step), epoch_idx, mesh, rules,
+                    zero_stage)
         self.publishes += 1
         self._logger.info("published weights (step %d) -> %s", step, path)
         self._prune(keep_path=path)
@@ -116,6 +118,7 @@ class WeightPublisher:
         epoch_idx: Optional[int],
         mesh: Any,
         rules: Any,
+        zero_stage: Optional[int] = None,
     ) -> None:
         import orbax.checkpoint as ocp
 
@@ -138,7 +141,7 @@ class WeightPublisher:
             )
         manifest = integrity.build_manifest(
             items, iter_idx=step, epoch_idx=epoch_idx,
-            checksums=True, mesh=mesh, rules=rules,
+            checksums=True, mesh=mesh, rules=rules, zero_stage=zero_stage,
         )
         if jax.process_index() == 0:
             integrity.write_manifest(path, manifest)

@@ -38,8 +38,10 @@ from rocket_tpu.parallel.mesh import DATA_AXES, MeshSpec, data_parallel_mesh
 from rocket_tpu.parallel.sharding import (
     DEFAULT_PARTITION_RULES,
     DEFAULT_RULES,
+    ZERO_STAGES,
     PartitionRules,
     ShardingRules,
+    ZeroIncompatibleError,
     batch_sharding,
     replicated,
 )
@@ -58,6 +60,7 @@ class Runtime:
         donate_train_state: Optional[bool] = None,
         partition_rules: Optional[PartitionRules] = None,
         zero_stage: int = 0,
+        zero_offload: bool = False,
     ) -> None:
         if mesh is None:
             mesh = data_parallel_mesh()
@@ -84,13 +87,28 @@ class Runtime:
             else DEFAULT_PARTITION_RULES.with_axes(rules)
         )
         # ZeRO stage (arXiv 2004.13336): 0 = replicated optimizer state,
-        # 1 = optimizer state + weight update sharded over the data axis
-        # (params all-gathered inside the step; bit-equal trajectory).
-        if zero_stage not in (0, 1):
+        # 1 = optimizer state + weight update sharded over the data axis,
+        # 2 = + gradients reduce-scattered into the shard owner,
+        # 3 = + params stored sharded with all-gather-on-demand.  Every
+        # stage keeps the training trajectory bit-equal to unsharded.
+        if zero_stage not in ZERO_STAGES:
             raise ValueError(
-                f"zero_stage must be 0 or 1, got {zero_stage!r}"
+                f"zero_stage must be one of {ZERO_STAGES}, got {zero_stage!r}"
             )
         self.zero_stage = int(zero_stage)
+        # Host-RAM offload of shard-owner optimizer state (double-buffered
+        # H2D prefetch one step ahead; engine.offload.ZeroOffloader).  Only
+        # meaningful when the opt state is actually sharded.
+        if zero_offload and self.zero_stage < 1:
+            raise ZeroIncompatibleError(
+                "zero_offload", self.zero_stage,
+                "set zero_stage >= 1 so the optimizer state has a shard "
+                "owner to offload",
+                detail="offload stashes each shard owner's opt-state "
+                "partition in host RAM; with replicated opt state there "
+                "is no partition to own",
+            )
+        self.zero_offload = bool(zero_offload)
         self.seed = int(seed)
         # Host-side structured tracing (observe.trace): arming here turns
         # on the Dispatcher's per-capsule lifecycle spans, the serve loop's

@@ -143,6 +143,7 @@ _HEAVY_TAIL = (
     "test_trainserve.py",
     "test_tenants_proc.py",
     "test_tracing_proc.py",
+    "test_zero_offload.py",
 )
 
 
@@ -150,7 +151,7 @@ _HEAVY_TAIL = (
 # budget truncates, the cut lands on the newest coverage first and the
 # long-standing seed suite still runs to completion.
 _TAIL_END = ("test_trainserve.py", "test_tenants_proc.py",
-             "test_tracing_proc.py")
+             "test_tracing_proc.py", "test_zero_offload.py")
 
 
 def pytest_collection_modifyitems(config, items):

@@ -1275,6 +1275,24 @@ class TestZeroGuard:
             f"vs replicated {repl_opt / (1 << 30):.2f} GB — expected a "
             f">= {(N - 1) / N:.0%} drop"
         )
+        # stage 3 divides the PARAM storage bytes by N as well
+        s3 = specs_for_state(
+            runtime.mesh, abstract, param_specs=param_specs, zero_stage=3)
+        repl_param = memory_plan(
+            abstract, repl.state_specs, runtime.mesh)["param_bytes"]
+        s3_param = memory_plan(
+            abstract, s3.state_specs, runtime.mesh)["param_bytes"]
+        assert s3_param <= repl_param / N + (1 << 20), (
+            f"zero_stage=3 param storage {s3_param / (1 << 30):.2f} GB vs "
+            f"replicated {repl_param / (1 << 30):.2f} GB — expected a "
+            f">= {(N - 1) / N:.0%} drop"
+        )
+        # offload books the optimizer shard against the host tier instead
+        off = memory_plan(
+            abstract, s3.state_specs, runtime.mesh, zero_offload=True)
+        assert off["opt_bytes"] == 0
+        assert off["host_opt_bytes"] > 0
+        assert off["total_bytes"] == off["param_bytes"] + off["other_bytes"]
 
     def test_zero_stage1_no_retrace_per_step(self, devices):
         """The ZeRO constraints live INSIDE the jitted step: stepping N
@@ -1338,11 +1356,18 @@ class TestZeroGuard:
             return warm, steps["sync"]._cache_size()
 
         base_warm, base_final = trace_counts(0)
-        zero_warm, zero_final = trace_counts(1)
-        assert zero_final == zero_warm, "zero_stage=1 retraces per step"
-        assert zero_final == base_final, (
-            f"zero_stage=1 traced {zero_final}x vs baseline {base_final}x"
-        )
+        for stage in (1, 2, 3):
+            zero_warm, zero_final = trace_counts(stage)
+            assert zero_final == zero_warm, (
+                f"zero_stage={stage} retraces per step"
+            )
+            # <= not ==: stages whose outputs carry explicit shard-plan
+            # constraints skip the baseline's one-time output-sharding
+            # normalization retrace, so they can legitimately trace FEWER
+            assert zero_final <= base_final, (
+                f"zero_stage={stage} traced {zero_final}x "
+                f"vs baseline {base_final}x"
+            )
 
 
 class TestPipelineGuard:

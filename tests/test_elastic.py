@@ -596,3 +596,102 @@ def test_zero1_snapshot_reshards_onto_larger_data_axis(tmp_path, devices):
 
     stitched = rec_b.losses + rec_c.losses
     np.testing.assert_allclose(stitched, rec_a.losses, rtol=1e-5, atol=1e-6)
+
+
+# -- ZeRO stage transitions across restores ----------------------------------
+
+
+def test_zero_stage1_snapshot_resumes_at_stage3_on_new_mesh(tmp_path,
+                                                            devices):
+    """A stage-1 snapshot (4-way data axis) resumes into a stage-3 run on
+    8 devices: params re-partition into the zero storage domain, the
+    manifest carries the saving stage, and the stitched trajectory still
+    matches the uninterrupted unsharded reference — a ZeRO stage change
+    across a restore is a placement change, never a numerics change."""
+    import jax
+
+    data = synthetic_classification(n=256)
+
+    launcher_a, model_a, rec_a = _tree(tmp_path, data, tag="ztref", epochs=1)
+    launcher_a.launch()
+    assert len(rec_a.losses) == 4
+
+    launcher_b, model_b, rec_b = _tree(
+        tmp_path, data, tag="ztrans13", epochs=1, mesh=_mesh(4),
+        zero_stage=1, extra=[SigtermInjector(at_iter=2)],
+    )
+    launcher_b.launch()
+    assert len(rec_b.losses) == 3
+    snap = tmp_path / "ztrans13" / "v0" / "weights" / "000002"
+    assert snap.is_dir()
+    meta = integrity.manifest_mesh(str(snap))
+    assert meta["axes"]["data"] == 4
+    assert meta["zero_stage"] == 1  # manifests stamp the saving stage
+
+    launcher_c, model_c, rec_c = _tree(
+        tmp_path, data, tag="ztrans13", epochs=1, mesh=_mesh(8),
+        zero_stage=3, resume="auto",
+    )
+    launcher_c.launch()
+    assert len(rec_c.losses) == 1
+
+    # stage-3 storage domain for real: the restored Dense_0 kernel is
+    # data-sliced across all 8 devices, not replicated
+    kernel = next(
+        leaf for leaf in jax.tree_util.tree_leaves(model_c.state.params)
+        if getattr(leaf, "shape", None) == (16, 32)
+    )
+    assert "data" in str(kernel.sharding.spec), kernel.sharding.spec
+    assert {s.data.shape for s in kernel.addressable_shards} == {(2, 32)}
+
+    stitched = rec_b.losses + rec_c.losses
+    np.testing.assert_allclose(stitched, rec_a.losses, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        _flat(model_c.state.params), _flat(model_a.state.params),
+        rtol=1e-5, atol=1e-6,
+    )
+
+
+def test_zero_stage3_snapshot_resumes_at_stage0_on_new_mesh(tmp_path,
+                                                            devices):
+    """The inverse transition: a stage-3 run (params stored sharded on a
+    4-way axis) is preempted and resumed as a plain unsharded stage-0 run
+    on 2 devices — everything gathers back to replicated and the
+    trajectory stitches against the uninterrupted reference."""
+    import jax
+
+    data = synthetic_classification(n=256)
+
+    launcher_a, model_a, rec_a = _tree(tmp_path, data, tag="ztref0", epochs=1)
+    launcher_a.launch()
+    assert len(rec_a.losses) == 4
+
+    launcher_b, model_b, rec_b = _tree(
+        tmp_path, data, tag="ztrans30", epochs=1, mesh=_mesh(4),
+        zero_stage=3, extra=[SigtermInjector(at_iter=2)],
+    )
+    launcher_b.launch()
+    assert len(rec_b.losses) == 3
+    snap = tmp_path / "ztrans30" / "v0" / "weights" / "000002"
+    assert integrity.manifest_mesh(str(snap))["zero_stage"] == 3
+
+    launcher_c, model_c, rec_c = _tree(
+        tmp_path, data, tag="ztrans30", epochs=1, mesh=_mesh(2),
+        zero_stage=0, resume="auto",
+    )
+    launcher_c.launch()
+    assert len(rec_c.losses) == 1
+
+    # back to stage 0: params and optimizer mirrors fully replicated
+    for leaf in jax.tree_util.tree_leaves(model_c.state.params):
+        assert "data" not in str(leaf.sharding.spec), leaf.sharding.spec
+    for leaf in jax.tree_util.tree_leaves(model_c.state.opt_state):
+        if hasattr(leaf, "sharding"):
+            assert "data" not in str(leaf.sharding.spec), leaf.sharding.spec
+
+    stitched = rec_b.losses + rec_c.losses
+    np.testing.assert_allclose(stitched, rec_a.losses, rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        _flat(model_c.state.params), _flat(model_a.state.params),
+        rtol=1e-5, atol=1e-6,
+    )

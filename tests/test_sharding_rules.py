@@ -151,6 +151,18 @@ class TestManifestRoundTrip:
         assert rebuilt.specs_for_tree(tree) == \
             DEFAULT_PARTITION_RULES.specs_for_tree(tree)
 
+    def test_zero_stage_stamp_round_trips(self):
+        """Manifests stamp the ZeRO stage the run was sharded at; legacy
+        manifests (no kwarg) omit the key entirely so old snapshots keep
+        the strict stage-less restore path."""
+        mesh = _mesh(data=2, fsdp=2, tensor=2)
+        stamped = integrity.build_manifest(
+            {}, mesh=mesh, rules=DEFAULT_PARTITION_RULES, zero_stage=3)
+        assert json.loads(json.dumps(stamped))["mesh"]["zero_stage"] == 3
+        legacy = integrity.build_manifest(
+            {}, mesh=mesh, rules=DEFAULT_PARTITION_RULES)
+        assert "zero_stage" not in json.loads(json.dumps(legacy))["mesh"]
+
     def test_check_reshard_accepts_rule_derived_targets(self):
         """check_reshard and the trainer resolve from the same table: a
         target tree shardend via PartitionRules passes the restore gate."""
@@ -287,6 +299,102 @@ def test_zoo_default_rules_match_annotations(name):
             )
 
 
+def _data_eligible(spec, shape, mesh):
+    """Independent recomputation of zero_compose's fold condition: True iff
+    the data axis can divide some dim of the leaf given its base spec."""
+    shape = tuple(shape)
+    if int(np.prod(shape)) <= 1:
+        return False
+    axes = dict(mesh.shape)
+    if axes.get("data", 1) <= 1:
+        return False
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    for dim, entry in zip(shape, entries):
+        names = (
+            () if entry is None
+            else (entry,) if isinstance(entry, str) else tuple(entry)
+        )
+        if "data" in names:
+            return True
+        factor = axes["data"] * int(np.prod([axes[n] for n in names] or [1]))
+        if dim % factor == 0:
+            return True
+    return False
+
+
+@pytest.mark.parametrize("name", [
+    "transformer", "transformer-scan", "transformer-int8", "moe",
+    "transformer-pipelined", "vit", "resnet", "seq2seq", "lenet",
+])
+def test_zoo_rules_resolve_zero_stage_2_and_3_leaves(name):
+    """CI lint for ZeRO stages 2/3: every zoo config's rule-derived table
+    must produce a plan whose grad-accum (stage 2) and param-storage
+    (stage 3) trees equal the leafwise zero_compose of the base specs —
+    and every leaf the data axis *can* divide must actually carry it.  No
+    silent fall-through to replicated.  (AdamW has no matrix-update
+    exemptions, so nothing is legitimately left at base here except
+    genuinely indivisible leaves.)"""
+    model, batch = _zoo_configs()[name]
+    mesh = _mesh(data=2, fsdp=2, tensor=2)
+    from rocket_tpu.engine.adapter import FlaxModel
+
+    adapter = FlaxModel(model)
+    params, _ = jax.eval_shape(
+        lambda: adapter.init_variables(jax.random.PRNGKey(0), batch)
+    )
+    pspecs = DEFAULT_PARTITION_RULES.specs_for_tree(params)
+    abstract = jax.eval_shape(lambda: TrainState.create(
+        params, optax.adamw(1e-3), gradient_accumulation_steps=2))
+
+    is_spec = lambda x: isinstance(x, P)
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+    base_leaves = [
+        P() if s is None else s
+        for s in jax.tree_util.tree_leaves(pspecs, is_leaf=is_spec)
+    ]
+    expected = [
+        zero_compose(s, tuple(leaf.shape), mesh)
+        for (_, leaf), s in zip(flat, base_leaves)
+    ]
+
+    plan2 = specs_for_state(
+        mesh, abstract, param_specs=pspecs, zero_stage=2,
+        make_shardings=False)
+    plan3 = specs_for_state(
+        mesh, abstract, param_specs=pspecs, zero_stage=3,
+        make_shardings=False)
+
+    got_accum = jax.tree_util.tree_leaves(
+        plan2.state_specs.grad_accum, is_leaf=is_spec)
+    got_params = jax.tree_util.tree_leaves(
+        plan3.state_specs.params, is_leaf=is_spec)
+    assert len(got_accum) == len(got_params) == len(expected)
+
+    mismatches = []
+    for (path, leaf), base, want, ga, p3 in zip(
+            flat, base_leaves, expected, got_accum, got_params):
+        where = f"{canonical_path(path)} shape={tuple(leaf.shape)}"
+        if ga != want:
+            mismatches.append(f"{where}: stage-2 grad_accum {ga} != {want}")
+        if p3 != want:
+            mismatches.append(f"{where}: stage-3 params {p3} != {want}")
+        # eligibility cross-check: a divisible leaf must gain the axis
+        eligible = _data_eligible(base, leaf.shape, mesh)
+        gained = any(
+            "data" in ((e,) if isinstance(e, str) else tuple(e or ()))
+            for e in want
+        )
+        if eligible != gained:
+            mismatches.append(
+                f"{where}: base={base} composed={want} "
+                f"eligible={eligible} but gained={gained}"
+            )
+    assert not mismatches, "\n".join(mismatches)
+    # stage 2 leaves the forward/backward param domain untouched
+    assert jax.tree_util.tree_leaves(
+        plan2.state_specs.params, is_leaf=is_spec) == base_leaves
+
+
 # -- zero_compose -------------------------------------------------------------
 
 
@@ -370,6 +478,70 @@ class TestSpecsForState:
         assert plan.state_specs.grad_accum == self._pspecs
         assert plan.state_specs.micro == P()
 
+    def test_zero_stage2_grad_accum_zero_composed(self):
+        """Stage 2 moves the accumulation buffers into the zero domain —
+        gradients reduce-scatter straight into the shard owner."""
+        mesh = _mesh(data=4, tensor=2)
+        plan = specs_for_state(
+            mesh, self._state(optax.adam(1e-2), accum=2),
+            param_specs=self._pspecs, zero_stage=2)
+        ga = plan.state_specs.grad_accum
+        assert ga["w1"] == P(("data",), "tensor")
+        assert ga["w2"] == P(("tensor", "data"), None)
+        assert ga["b"] == P(("data",))
+        # forward/backward domain is untouched at stage 2
+        assert plan.state_specs.params == self._pspecs
+
+    def test_zero_stage3_params_storage_zero_composed(self):
+        """Stage 3 stores the params themselves on the zero shard; the
+        compute specs keep the base layout (the step gathers on demand)."""
+        mesh = _mesh(data=4, tensor=2)
+        plan = specs_for_state(
+            mesh, self._state(optax.adam(1e-2)),
+            param_specs=self._pspecs, zero_stage=3)
+        stored = plan.state_specs.params
+        assert stored["w1"] == P(("data",), "tensor")
+        assert stored["w2"] == P(("tensor", "data"), None)
+        assert stored["b"] == P(("data",))
+        assert plan.param_specs == self._pspecs
+        # optimizer mirrors live in the same domain as the storage
+        assert plan.state_specs.opt_state[0].mu == stored
+
+    def test_zero_stage3_muon_rank2_params_stay_base(self):
+        """Muon's matrix-update exemption extends to the storage domain:
+        rank-2 params are never data-sliced, only the rank-1 bias is."""
+        mesh = _mesh(data=4, tensor=2)
+        plan = specs_for_state(
+            mesh, self._state(muon(1e-2)),
+            param_specs=self._pspecs, zero_stage=3)
+        stored = plan.state_specs.params
+        assert stored["w1"] == P(None, "tensor")
+        assert stored["w2"] == P("tensor", None)
+        assert stored["b"] == P(("data",))
+
+    def test_invalid_zero_stage_rejected(self):
+        mesh = _mesh(data=4, tensor=2)
+        with pytest.raises(ValueError, match="zero_stage"):
+            specs_for_state(
+                mesh, self._state(optax.adam(1e-2)),
+                param_specs=self._pspecs, zero_stage=4)
+
+    def test_make_shardings_false_prices_hypothetical_mesh(self):
+        """Spec arithmetic must run against a mesh this host doesn't have
+        (bench.py's 30B memory-plan rows): any object with a ``.shape``
+        mapping works when NamedSharding construction is skipped."""
+        class PodMesh:
+            shape = {"data": 64, "tensor": 1}
+
+        plan = specs_for_state(
+            PodMesh(), self._state(optax.adam(1e-2)),
+            param_specs=self._pspecs, zero_stage=3, make_shardings=False)
+        assert plan.param_shardings is None
+        assert plan.zero_param_shardings is None
+        assert plan.state_shardings is None
+        assert plan.state_specs.params["w1"] == P(("data",), "tensor")
+        assert plan.state_specs.opt_state[0].mu["b"] == P(("data",))
+
     def test_muon_rank2_exempt_from_zero(self):
         """Newton-Schulz orthogonalization reduces over the full matrix:
         rank-2 params (and their momenta) must keep base sharding."""
@@ -415,31 +587,35 @@ def _bit_eq_setup():
     return params, pspecs, apply_fn, loss
 
 
-def _run_zero(tx, zero_stage, steps_n=6):
+def _run_zero(tx, zero_stage, steps_n=6, accum=1):
     """Train `steps_n` steps on a data=4 × tensor=2 mesh through the repo's
-    own machinery (specs_for_state + build_train_step)."""
+    own machinery (specs_for_state + build_train_step).  ``accum > 1``
+    drives the micro/sync cadence (``steps_n`` counts micro batches)."""
     mesh = _mesh(data=4, tensor=2)
     params, pspecs, apply_fn, loss = _bit_eq_setup()
-    abstract = jax.eval_shape(lambda: TrainState.create(params, tx))
+    abstract = jax.eval_shape(lambda: TrainState.create(
+        params, tx, gradient_accumulation_steps=accum))
     plan = specs_for_state(
         mesh, abstract, param_specs=pspecs, zero_stage=zero_stage)
-    state = TrainState.create(params, tx)
+    state = TrainState.create(params, tx, gradient_accumulation_steps=accum)
     state = jax.device_put(state, plan.state_shardings)
     step_fns = build_train_step(
         apply_fn, [Objective("mse", loss)], tx,
+        gradient_accumulation_steps=accum,
         shard_plan=plan if zero_stage else None,
     )
     batch_sh = NamedSharding(mesh, P("data"))
     rng = np.random.default_rng(0)
     losses = []
-    for _ in range(steps_n):
+    for i in range(steps_n):
         batch = {
             "x": jax.device_put(
                 jnp.asarray(rng.normal(size=(8, 64)), jnp.float32), batch_sh),
             "y": jax.device_put(
                 jnp.asarray(rng.normal(size=(8, 64)), jnp.float32), batch_sh),
         }
-        state, logs = step_fns["sync"](state, batch)
+        fn = step_fns["sync"] if (i + 1) % accum == 0 else step_fns["micro"]
+        state, logs = fn(state, batch)
         losses.append(float(logs["loss"]))
     return losses, jax.device_get(state.params), jax.device_get(state.opt_state)
 
@@ -453,15 +629,22 @@ def _tx_variants():
     }
 
 
-@pytest.mark.parametrize("variant", ["adam", "muon", "adam+ema", "muon+ema"])
-def test_zero_stage1_bitwise_equals_unsharded(variant):
-    """ZeRO-1 must not change the training trajectory AT ALL: per-step
-    losses, final params, and final optimizer state are compared bitwise
-    against the unsharded optimizer path on the same mesh."""
-    tx = _tx_variants()[variant]
-    l0, p0, o0 = _run_zero(tx, zero_stage=0)
-    tx = _tx_variants()[variant]
-    l1, p1, o1 = _run_zero(tx, zero_stage=1)
+_ORACLES = {}
+
+
+def _oracle(variant, accum=1):
+    """Memoized unsharded (zero_stage=0) trajectory per optimizer variant —
+    the oracle every sharded stage is compared against bitwise."""
+    key = (variant, accum)
+    if key not in _ORACLES:
+        _ORACLES[key] = _run_zero(
+            _tx_variants()[variant], zero_stage=0, accum=accum)
+    return _ORACLES[key]
+
+
+def _assert_bit_equal(ref, got):
+    l0, p0, o0 = ref
+    l1, p1, o1 = got
     assert l0 == l1
     for a, b in zip(jax.tree_util.tree_leaves(p0),
                     jax.tree_util.tree_leaves(p1)):
@@ -469,3 +652,38 @@ def test_zero_stage1_bitwise_equals_unsharded(variant):
     for a, b in zip(jax.tree_util.tree_leaves(o0),
                     jax.tree_util.tree_leaves(o1)):
         np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("variant", ["adam", "muon", "adam+ema", "muon+ema"])
+def test_zero_stage1_bitwise_equals_unsharded(variant):
+    """ZeRO-1 must not change the training trajectory AT ALL: per-step
+    losses, final params, and final optimizer state are compared bitwise
+    against the unsharded optimizer path on the same mesh."""
+    _assert_bit_equal(
+        _oracle(variant),
+        _run_zero(_tx_variants()[variant], zero_stage=1),
+    )
+
+
+@pytest.mark.parametrize("stage", [2, 3])
+@pytest.mark.parametrize("variant", ["adam", "muon", "adam+ema", "muon+ema"])
+def test_zero_stage23_bitwise_equals_unsharded(stage, variant):
+    """Stages 2 (grads reduce-scattered into the shard owner) and 3
+    (params stored sharded, gathered on demand) are pure layout moves:
+    the trajectory must stay bitwise identical to the unsharded path."""
+    _assert_bit_equal(
+        _oracle(variant),
+        _run_zero(_tx_variants()[variant], zero_stage=stage),
+    )
+
+
+@pytest.mark.parametrize("stage", [2, 3])
+@pytest.mark.parametrize("variant", ["adam", "muon"])
+def test_zero_stage23_bitwise_with_grad_accum(stage, variant):
+    """Gradient accumulation under stages 2/3: micro-sums happen on the
+    zero shard (elementwise, exact) — still bitwise vs the unsharded
+    accumulating oracle."""
+    _assert_bit_equal(
+        _oracle(variant, accum=2),
+        _run_zero(_tx_variants()[variant], zero_stage=stage, accum=2),
+    )

@@ -99,7 +99,9 @@ def test_library_emits_trace_events():
             # critical-path event vocabulary (docs/observability.md)
             "serve/request", "serve/pool_fetch", "serve/first_token",
             "serve/new_weights", "fleet/delivered", "fleet/requeued",
-            "pool/fetch"} <= names
+            "pool/fetch",
+            # ZeRO host-offload round trip (engine/offload.py)
+            "offload/d2h", "offload/h2d"} <= names
 
 
 # -- jax.jit chokepoint lint (ISSUE 15 satellite) ----------------------------
